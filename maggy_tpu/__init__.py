@@ -1,0 +1,29 @@
+"""maggy_tpu: TPU-native asynchronous black-box optimization framework.
+
+A from-scratch JAX/XLA/pjit/Pallas re-design of the capabilities of
+maggy (asynchronous hyperparameter optimization, ablation studies, and
+distributed training): a driver process schedules asynchronous trials onto
+per-trial JAX process groups pinned to TPU sub-slices; gradients flow over
+ICI via XLA collectives; a DCN control plane streams heartbeat metrics back
+to driver-side optimizers for early stopping and promotion.
+"""
+
+__version__ = "0.1.0"
+
+from maggy_tpu.searchspace import Searchspace
+from maggy_tpu.trial import Trial
+from maggy_tpu.config import (
+    LagomConfig,
+    OptimizationConfig,
+    AblationConfig,
+    DistributedConfig,
+)
+
+__all__ = [
+    "Searchspace",
+    "Trial",
+    "LagomConfig",
+    "OptimizationConfig",
+    "AblationConfig",
+    "DistributedConfig",
+]

@@ -1,0 +1,97 @@
+"""Typed experiment configs; the config type selects the experiment kind.
+
+Parity: reference `maggy/experiment_config.py:18-81` (LagomConfig base,
+OptimizationConfig, AblationConfig, DistributedConfig). Redesigned for TPU:
+``DistributedConfig`` describes a JAX mesh + sharding strategy instead of a
+torch module, and every config carries ``num_workers`` explicitly (the
+reference infers it from Spark dynamic-allocation settings,
+`hopsworks.py:236-244`, which has no TPU analogue).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Union
+
+from maggy_tpu import constants
+from maggy_tpu.searchspace import Searchspace
+
+
+@dataclass
+class LagomConfig:
+    """Base config (reference `experiment_config.py:18-23`)."""
+
+    name: str = "maggyTpuExperiment"
+    description: str = ""
+    hb_interval: float = constants.DEFAULT_HEARTBEAT_INTERVAL_S
+
+
+@dataclass
+class OptimizationConfig(LagomConfig):
+    """Hyperparameter-optimization experiment (reference `experiment_config.py:25-50`).
+
+    ``optimizer`` is a registry name ("randomsearch", "gridsearch", "asha",
+    "tpe", "gp", "none") or an AbstractOptimizer instance. ``num_workers`` is
+    the number of concurrent trial runners (local processes or TPU sub-slice
+    agents); it is clamped to ``num_trials`` by the driver.
+    """
+
+    num_trials: int = 1
+    optimizer: Union[str, Any] = "randomsearch"
+    searchspace: Optional[Searchspace] = None
+    optimization_key: str = "metric"
+    direction: str = "max"
+    es_interval: int = constants.DEFAULT_ES_INTERVAL
+    es_min: int = constants.DEFAULT_ES_MIN
+    es_policy: Union[str, Any] = constants.DEFAULT_ES_POLICY
+    num_workers: int = 1
+    seed: Optional[int] = None
+    # Per-trial device assignment: how many TPU chips each trial gets.
+    chips_per_trial: int = 1
+    # Experiment artifact root; defaults to the environment's base dir.
+    experiment_dir: Optional[str] = None
+
+    def __post_init__(self):
+        if self.direction not in ("max", "min"):
+            raise ValueError("direction must be 'max' or 'min', got {!r}".format(self.direction))
+
+
+@dataclass
+class AblationConfig(LagomConfig):
+    """Ablation-study experiment (reference `experiment_config.py:52-66`)."""
+
+    ablation_study: Any = None
+    ablator: Union[str, Any] = "loco"
+    direction: str = "max"
+    optimization_key: str = "metric"
+    num_workers: int = 1
+    chips_per_trial: int = 1
+    experiment_dir: Optional[str] = None
+
+    def __post_init__(self):
+        if self.direction not in ("max", "min"):
+            raise ValueError("direction must be 'max' or 'min', got {!r}".format(self.direction))
+
+
+@dataclass
+class DistributedConfig(LagomConfig):
+    """Distributed data/model-parallel training of ONE model (reference
+    `experiment_config.py:68-81`, where it carried a torch module + datasets).
+
+    TPU-native version: the user's ``train_fn`` receives a `ShardingEnv`
+    (mesh + named shardings + process info) instead of a DDP-wrapped model;
+    gradients flow over ICI via XLA collectives inserted by GSPMD.
+    """
+
+    #: Flax module / model spec forwarded to the train function.
+    model: Any = None
+    train_set: Any = None
+    test_set: Any = None
+    #: Number of participating processes (multi-host world size).
+    num_workers: int = 1
+    #: Logical mesh axes, e.g. {"data": 8} or {"data": 4, "model": 2}.
+    mesh_shape: Dict[str, int] = field(default_factory=dict)
+    #: Parallelism strategy name: "dp", "fsdp", "tp", "dp_tp", "sp".
+    strategy: str = "dp"
+    backend: Optional[str] = None
+    experiment_dir: Optional[str] = None

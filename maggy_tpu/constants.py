@@ -1,0 +1,31 @@
+"""Framework-wide constants.
+
+Parity: reference `maggy/constants.py:23-28` (allowed user-function return
+types and numeric types). Extended with TPU-framework defaults.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class USER_FCT:
+    """Allowed return types of a user training function."""
+
+    RETURN_TYPES = (float, int, np.number, dict)
+    NUMERIC_TYPES = (float, int, np.number)
+
+
+# Control-plane defaults (see BASELINE.md "scheduling constants").
+DEFAULT_HEARTBEAT_INTERVAL_S = 1.0
+DRIVER_IDLE_REQUEUE_TICK_S = 0.1
+CLIENT_POLL_INTERVAL_S = 1.0
+REGISTRATION_TIMEOUT_S = 600.0
+RENDEZVOUS_TIMEOUT_S = 60.0
+CLIENT_MAX_RETRIES = 3
+RPC_RECV_BUFSIZE = 1 << 16
+
+# Early-stop defaults (reference `maggy/experiment_config.py:33-35`).
+DEFAULT_ES_INTERVAL = 1
+DEFAULT_ES_MIN = 10
+DEFAULT_ES_POLICY = "median"

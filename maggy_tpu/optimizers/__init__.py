@@ -1,0 +1,9 @@
+"""Driver-side search/scheduling algorithm plugins."""
+
+from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
+from maggy_tpu.optimizers.randomsearch import RandomSearch
+from maggy_tpu.optimizers.gridsearch import GridSearch
+from maggy_tpu.optimizers.singlerun import SingleRun
+from maggy_tpu.optimizers.asha import Asha
+
+__all__ = ["AbstractOptimizer", "RandomSearch", "GridSearch", "SingleRun", "Asha"]

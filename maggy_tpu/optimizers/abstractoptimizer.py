@@ -1,0 +1,198 @@
+"""Abstract optimizer: the driver-side search-algorithm plugin contract.
+
+Parity: reference `maggy/optimizer/abstractoptimizer.py` — contract at
+:54-79; driver-injected attributes at :36-40 (wired by
+`optimization_driver.py:87-93`); observation getters with direction
+normalization at :136-252; duplicate detection at :254-295; pruner init at
+:297-315; trial factory with info_dict/budget injection at :317-376;
+ybest/yworst/ymean at :378-443.
+
+Design change vs reference: all optimizers take an optional ``seed`` and draw
+from their own ``numpy.random.Generator`` — reproducible schedules.
+"""
+
+from __future__ import annotations
+
+import time
+from abc import ABC, abstractmethod
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from maggy_tpu.searchspace import Searchspace
+from maggy_tpu.trial import Trial
+
+
+class AbstractOptimizer(ABC):
+    def __init__(self, seed: Optional[int] = None, pruner=None, pruner_kwargs=None):
+        # Injected by the driver after construction (reference
+        # `optimization_driver.py:87-93`).
+        self.searchspace: Optional[Searchspace] = None
+        self.num_trials: int = 0
+        self.trial_store: Dict[str, Trial] = {}
+        self.final_store: List[Trial] = []
+        self.direction: str = "max"
+
+        self.seed = seed
+        self.rng = np.random.default_rng(seed)
+        self.pruner = None
+        self._pruner_name = pruner
+        self._pruner_kwargs = pruner_kwargs or {}
+        self._log_lines: List[str] = []
+
+    # ------------------------------------------------------------- contract
+
+    @abstractmethod
+    def initialize(self) -> None:
+        """Called once by the driver before any suggestions are requested."""
+
+    @abstractmethod
+    def get_suggestion(self, trial: Optional[Trial] = None):
+        """Return the next Trial, "IDLE" (ask again later), or None (done).
+
+        ``trial`` is the just-finalized trial, if any (reference
+        `abstractoptimizer.py:62-75`).
+        """
+
+    def finalize_experiment(self, trials: List[Trial]) -> None:
+        """Called once after the experiment completes."""
+
+    # ------------------------------------------------------------- plumbing
+
+    def _initialize(self, exp_dir: Optional[str] = None) -> None:
+        """Driver-side init hook: sets up pruner and calls initialize()."""
+        self.init_pruner()
+        self.initialize()
+
+    def _finalize_experiment(self, trials: List[Trial]) -> None:
+        self.finalize_experiment(trials)
+
+    def _log(self, msg: str) -> None:
+        self._log_lines.append("{:.3f} {}".format(time.time(), msg))
+
+    def init_pruner(self):
+        """Instantiate the pruner by name; only 'hyperband' exists (reference
+        `abstractoptimizer.py:297-315`)."""
+        if self._pruner_name is None:
+            return None
+        if isinstance(self._pruner_name, str):
+            if self._pruner_name.lower() != "hyperband":
+                raise ValueError(
+                    "Unknown pruner '{}'; supported: 'hyperband'.".format(self._pruner_name)
+                )
+            from maggy_tpu.pruner.hyperband import Hyperband
+
+            self.pruner = Hyperband(
+                trial_metric_getter=self.get_metrics_dict, **self._pruner_kwargs
+            )
+        else:
+            self.pruner = self._pruner_name  # pre-built instance
+            self.pruner.trial_metric_getter = self.get_metrics_dict
+        return self.pruner
+
+    # --------------------------------------------------------- observations
+    #
+    # Everything is normalized to a MINIMIZATION problem: metrics are negated
+    # when direction == "max" (reference `abstractoptimizer.py:136-252`).
+
+    def _sign(self) -> float:
+        return -1.0 if self.direction == "max" else 1.0
+
+    def get_hparams_dict(self, trial_ids: Union[str, List[str], None] = None) -> Dict[str, dict]:
+        ids = self._select_ids(trial_ids)
+        return {t.trial_id: t.params for t in self.final_store if t.trial_id in ids}
+
+    def get_hparams_array(self, budget: Optional[float] = None) -> np.ndarray:
+        trials = self._finalized(budget)
+        return self.searchspace.transform_batch([self._strip_budget(t.params) for t in trials])
+
+    def get_metrics_dict(self, trial_ids: Union[str, List[str], None] = None) -> Dict[str, float]:
+        ids = self._select_ids(trial_ids)
+        sign = self._sign()
+        out = {}
+        for t in self.final_store:
+            if t.trial_id in ids and t.final_metric is not None:
+                out[t.trial_id] = sign * t.final_metric
+        return out
+
+    def get_metrics_array(self, budget: Optional[float] = None) -> np.ndarray:
+        trials = self._finalized(budget)
+        sign = self._sign()
+        return np.asarray([sign * t.final_metric for t in trials], dtype=np.float64)
+
+    def _finalized(self, budget: Optional[float] = None) -> List[Trial]:
+        out = [t for t in self.final_store if t.final_metric is not None]
+        if budget is not None and budget != 0:
+            out = [t for t in out if t.params.get("budget") == budget]
+        return out
+
+    def _select_ids(self, trial_ids) -> set:
+        if trial_ids is None:
+            return {t.trial_id for t in self.final_store}
+        if isinstance(trial_ids, str):
+            return {trial_ids}
+        return set(trial_ids)
+
+    @staticmethod
+    def _strip_budget(params: Dict[str, Any]) -> Dict[str, Any]:
+        return {k: v for k, v in params.items() if k != "budget"}
+
+    def hparams_exist(self, trial: Trial) -> bool:
+        """True if this trial's budget-stripped params match any finalized or
+        in-flight trial (reference `abstractoptimizer.py:254-295`)."""
+        target = self._strip_budget(trial.params)
+        for t in self.final_store:
+            if self._strip_budget(t.params) == target:
+                return True
+        for t in self.trial_store.values():
+            if self._strip_budget(t.params) == target:
+                return True
+        return False
+
+    # ----------------------------------------------------------- trial factory
+
+    def create_trial(
+        self,
+        hparams: Dict[str, Any],
+        sample_type: str = "random",
+        run_budget: float = 0,
+        model_budget: Optional[float] = None,
+    ) -> Trial:
+        """Build a Trial with provenance info (reference
+        `abstractoptimizer.py:317-376`): info_dict carries run_budget,
+        sample_type ∈ {random, random_forced, model, promoted, grid},
+        sampling_time, model_budget; the budget is injected into hparams when
+        multi-fidelity (pruner active)."""
+        info: Dict[str, Any] = {
+            "run_budget": run_budget,
+            "sample_type": sample_type,
+            "sampling_time": time.time(),
+        }
+        if model_budget is not None:
+            info["model_budget"] = model_budget
+        params = dict(hparams)
+        if self.pruner is not None and run_budget:
+            params["budget"] = run_budget
+        return Trial(params, trial_type="optimization", info_dict=info)
+
+    def get_max_budget(self) -> float:
+        if self.pruner is None:
+            raise ValueError("get_max_budget requires a pruner.")
+        return self.pruner.max_budget
+
+    # ------------------------------------------------------------- aggregates
+
+    def ybest(self, budget: Optional[float] = None) -> float:
+        y = self.get_metrics_array(budget=budget)
+        return float(np.min(y)) if y.size else float("inf")
+
+    def yworst(self, budget: Optional[float] = None) -> float:
+        y = self.get_metrics_array(budget=budget)
+        return float(np.max(y)) if y.size else float("-inf")
+
+    def ymean(self, budget: Optional[float] = None) -> float:
+        y = self.get_metrics_array(budget=budget)
+        return float(np.mean(y)) if y.size else float("nan")
+
+    def name(self) -> str:
+        return type(self).__name__

@@ -1,0 +1,35 @@
+"""Grid search over DISCRETE/CATEGORICAL spaces.
+
+Parity: reference `maggy/optimizer/gridsearch.py` — cartesian product
+(:72-79), continuous-param rejection (:81-90), `get_num_trials` classmethod
+used by the driver (:33-43), no pruner support (:47-51).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
+from maggy_tpu.searchspace import Searchspace
+from maggy_tpu.trial import Trial
+
+
+class GridSearch(AbstractOptimizer):
+    def __init__(self, seed=None, pruner=None, pruner_kwargs=None):
+        if pruner is not None:
+            raise ValueError("GridSearch does not support pruners.")
+        super().__init__(seed=seed)
+        self.config_buffer = []
+
+    @classmethod
+    def get_num_trials(cls, searchspace: Searchspace) -> int:
+        return len(searchspace.grid())
+
+    def initialize(self) -> None:
+        self.config_buffer = self.searchspace.grid()
+
+    def get_suggestion(self, trial: Optional[Trial] = None):
+        if not self.config_buffer:
+            return None
+        params = self.config_buffer.pop(0)
+        return self.create_trial(params, sample_type="grid")

@@ -1,0 +1,69 @@
+"""Random search, with optional multi-fidelity pruning.
+
+Parity: reference `maggy/optimizer/randomsearch.py` — pre-sampled buffer
+(:28-40), continuous-param requirement (:30-36), pruner delegation handling
+IDLE/None/promoted/fresh (:47-90), plain buffer pop otherwise (:93-106).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
+from maggy_tpu.searchspace import Searchspace
+from maggy_tpu.trial import Trial
+
+
+class RandomSearch(AbstractOptimizer):
+    def __init__(self, seed=None, pruner=None, pruner_kwargs=None):
+        super().__init__(seed=seed, pruner=pruner, pruner_kwargs=pruner_kwargs)
+        self.config_buffer = []
+
+    def initialize(self) -> None:
+        types = set(self.searchspace._hparam_types.values())
+        if not types & {Searchspace.DOUBLE, Searchspace.INTEGER}:
+            raise ValueError(
+                "RandomSearch requires at least one continuous (DOUBLE/INTEGER) "
+                "parameter; use GridSearch for purely discrete spaces."
+            )
+        if self.pruner is None:
+            self.config_buffer = self.searchspace.get_random_parameter_values(
+                self.num_trials, rng=self.rng
+            )
+
+    def get_suggestion(self, trial: Optional[Trial] = None):
+        if self.pruner is not None:
+            return self._pruner_suggestion(trial)
+        if not self.config_buffer:
+            return None
+        params = self.config_buffer.pop(0)
+        return self.create_trial(params, sample_type="random")
+
+    def _pruner_suggestion(self, trial: Optional[Trial]):
+        """Delegate budget/promotion decisions to the pruner (reference
+        `randomsearch.py:47-90`)."""
+        next_run = self.pruner.pruning_routine()
+        if next_run == "IDLE":
+            return "IDLE"
+        if next_run is None:
+            return None
+        parent_id, budget = next_run["trial_id"], next_run["budget"]
+        if parent_id is None:
+            # fresh rung-0 config
+            params = self.searchspace.get_random_parameter_values(1, rng=self.rng)[0]
+            new_trial = self.create_trial(params, sample_type="random", run_budget=budget)
+        else:
+            # promoted config re-run at a bigger budget
+            parent_params = self._lookup_params(parent_id)
+            params = self._strip_budget(parent_params)
+            new_trial = self.create_trial(params, sample_type="promoted", run_budget=budget)
+        self.pruner.report_trial(original_trial_id=parent_id, new_trial_id=new_trial.trial_id)
+        return new_trial
+
+    def _lookup_params(self, trial_id: str) -> dict:
+        for t in self.final_store:
+            if t.trial_id == trial_id:
+                return t.params
+        if trial_id in self.trial_store:
+            return self.trial_store[trial_id].params
+        raise KeyError("Unknown parent trial id {}".format(trial_id))

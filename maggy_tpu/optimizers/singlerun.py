@@ -1,0 +1,31 @@
+"""SingleRun: N empty-parameter trials for plain parallel execution.
+
+Parity: reference `maggy/optimizer/singlerun.py:21-37`; selected by
+optimizer="none" in the driver registry (`optimization_driver.py:40`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
+from maggy_tpu.trial import Trial
+
+
+class SingleRun(AbstractOptimizer):
+    def __init__(self, seed=None, pruner=None, pruner_kwargs=None):
+        if pruner is not None:
+            raise ValueError("SingleRun does not support pruners.")
+        super().__init__(seed=seed)
+
+    def initialize(self) -> None:
+        self._remaining = self.num_trials
+
+    def get_suggestion(self, trial: Optional[Trial] = None):
+        if self._remaining <= 0:
+            return None
+        self._remaining -= 1
+        # Distinguish otherwise-identical empty-param trials by an index so
+        # their md5 ids differ.
+        return self.create_trial({"run_index": self.num_trials - self._remaining - 1},
+                                 sample_type="random")

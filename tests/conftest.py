@@ -1,0 +1,22 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is unavailable in CI; sharding paths are validated on
+8 virtual CPU devices via XLA host-platform device multiplexing (the
+documented JAX approach for testing pjit/shard_map without accelerators).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_experiment_dir(tmp_path):
+    d = tmp_path / "experiments"
+    d.mkdir()
+    return str(d)

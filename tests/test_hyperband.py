@@ -1,0 +1,91 @@
+"""Hyperband pruner unit tests (the reference ships none; SURVEY.md §4)."""
+
+import numpy as np
+
+from maggy_tpu.optimizers import RandomSearch
+from maggy_tpu.pruner.hyperband import Hyperband, SHIteration
+from maggy_tpu.searchspace import Searchspace
+from maggy_tpu.trial import Trial
+
+
+def test_bracket_plan_bohb_shapes():
+    hb = Hyperband(trial_metric_getter=lambda *a, **k: {}, min_budget=1, max_budget=9, eta=3)
+    assert hb.max_sh_rungs == 3
+    assert np.allclose(hb.budgets, [1, 3, 9])
+    # bracket 0: s=2 -> n0 = ceil(3/3*9) = 9 configs over rungs [1,3,9]
+    n, b = hb._bracket_plan(0)
+    assert n == [9, 3, 1] and b == [1, 3, 9]
+    # bracket 1: s=1 -> n0 = ceil(3/2*3) = 5 over [3,9]
+    n, b = hb._bracket_plan(1)
+    assert n == [5, 1] and b == [3, 9]
+    # bracket 2: s=0 -> n0 = 3 at [9]
+    n, b = hb._bracket_plan(2)
+    assert n == [3] and b == [9]
+    assert hb.num_trials() == (9 + 3 + 1) + (5 + 1) + 3
+
+
+def test_sh_iteration_promotion_order():
+    metrics = {}
+    it = SHIteration(0, n_configs=[4, 2, 1], budgets=[1.0, 3.0, 9.0])
+    # Fill rung 0.
+    for i in range(4):
+        run = it.get_next_run(metrics)
+        assert run == {"trial_id": None, "budget": 1.0}
+        it.report_trial("t{}".format(i))
+    assert it.get_next_run(metrics) is None  # rung 0 running, nothing promotable
+    # Finalize rung 0 with metrics (lower = better).
+    metrics.update({"t0": 3.0, "t1": 1.0, "t2": 2.0, "t3": 4.0})
+    run = it.get_next_run(metrics)
+    assert run == {"trial_id": "t1", "budget": 3.0}  # best first
+    it.report_trial("p1")
+    run = it.get_next_run(metrics)
+    assert run == {"trial_id": "t2", "budget": 3.0}
+    it.report_trial("p2")
+    assert it.get_next_run(metrics) is None
+    metrics.update({"p1": 0.5, "p2": 0.7})
+    run = it.get_next_run(metrics)
+    assert run == {"trial_id": "p1", "budget": 9.0}
+    it.report_trial("f1")
+    assert not it.check_finished(metrics)
+    metrics["f1"] = 0.1
+    assert it.check_finished(metrics)
+
+
+def test_full_hyperband_via_randomsearch():
+    """End-to-end schedule execution through the optimizer delegation path."""
+    sp = Searchspace(lr=("DOUBLE", [0.0, 1.0]))
+    opt = RandomSearch(seed=3, pruner="hyperband",
+                       pruner_kwargs=dict(min_budget=1, max_budget=9, eta=3))
+    opt.searchspace = sp
+    opt.num_trials = 0
+    opt.trial_store = {}
+    opt.final_store = []
+    opt.direction = "min"
+    opt._initialize()
+    total = opt.pruner.num_trials()
+
+    executed = []
+    guard = 0
+    while guard < 500:
+        guard += 1
+        t = opt.get_suggestion()
+        if t is None:
+            break
+        if t == "IDLE":
+            continue
+        # run instantly: metric = lr (direction min)
+        t.final_metric = t.params["lr"]
+        t.status = Trial.FINALIZED
+        opt.final_store.append(t)
+        executed.append(t)
+    assert len(executed) == total
+    assert opt.pruner.finished()
+    # Promotions re-run good configs at higher budget.
+    budgets = sorted({t.params["budget"] for t in executed})
+    assert budgets == [1, 3, 9]
+    # In bracket 0 the config promoted to budget 9 is the best of its rung-1 cohort.
+    b0 = opt.pruner.iterations[0]
+    metrics = opt.get_metrics_dict()
+    top_actual = b0.actual_ids(2)[0]
+    rung1 = b0.actual_ids(1)
+    assert metrics[top_actual] <= min(metrics[a] for a in rung1) + 1e-12

@@ -1,0 +1,177 @@
+"""Optimizer unit tests: RandomSearch, GridSearch, SingleRun, ASHA, early stop.
+
+The reference has no optimizer unit coverage beyond random search
+(`test_randomsearch.py`); SURVEY.md §4 calls for a full pure-algorithm pyramid.
+These drive the optimizers exactly as the driver does: inject stores, call
+initialize, feed finalized trials back through get_suggestion.
+"""
+
+import numpy as np
+import pytest
+
+from maggy_tpu.earlystop import MedianStoppingRule, NoStoppingRule
+from maggy_tpu.optimizers import Asha, GridSearch, RandomSearch, SingleRun
+from maggy_tpu.searchspace import Searchspace
+from maggy_tpu.trial import Trial
+
+
+def wire(opt, searchspace, num_trials, direction="max"):
+    """Injection the driver performs (reference `optimization_driver.py:87-93`)."""
+    opt.searchspace = searchspace
+    opt.num_trials = num_trials
+    opt.trial_store = {}
+    opt.final_store = []
+    opt.direction = direction
+    opt._initialize()
+    return opt
+
+
+def finalize(opt, trial, metric):
+    trial.final_metric = metric
+    trial.status = Trial.FINALIZED
+    opt.trial_store.pop(trial.trial_id, None)
+    opt.final_store.append(trial)
+
+
+def space():
+    return Searchspace(lr=("DOUBLE", [0.0, 1.0]), units=("INTEGER", [8, 64]))
+
+
+class TestRandomSearch:
+    def test_produces_num_trials_then_none(self):
+        opt = wire(RandomSearch(seed=0), space(), 5)
+        trials = []
+        for _ in range(5):
+            t = opt.get_suggestion()
+            assert isinstance(t, Trial)
+            trials.append(t)
+        assert opt.get_suggestion() is None
+        assert len({t.trial_id for t in trials}) == 5
+
+    def test_requires_continuous_param(self):
+        sp = Searchspace(act=("CATEGORICAL", ["a", "b"]))
+        with pytest.raises(ValueError, match="continuous"):
+            wire(RandomSearch(), sp, 3)
+
+    def test_seeded_schedules_identical(self):
+        a = wire(RandomSearch(seed=13), space(), 4)
+        b = wire(RandomSearch(seed=13), space(), 4)
+        pa = [a.get_suggestion().params for _ in range(4)]
+        pb = [b.get_suggestion().params for _ in range(4)]
+        assert pa == pb
+
+
+class TestGridSearch:
+    def test_full_grid(self):
+        sp = Searchspace(pool=("DISCRETE", [2, 3]), act=("CATEGORICAL", ["r", "g"]))
+        assert GridSearch.get_num_trials(sp) == 4
+        opt = wire(GridSearch(), sp, 4)
+        seen = [opt.get_suggestion().params for _ in range(4)]
+        assert opt.get_suggestion() is None
+        assert len(seen) == 4
+        assert {"pool": 3, "act": "g"} in seen
+
+    def test_rejects_pruner(self):
+        with pytest.raises(ValueError, match="pruner"):
+            GridSearch(pruner="hyperband")
+
+
+class TestSingleRun:
+    def test_n_distinct_trials(self):
+        opt = wire(SingleRun(), space(), 3)
+        ids = {opt.get_suggestion().trial_id for _ in range(3)}
+        assert len(ids) == 3
+        assert opt.get_suggestion() is None
+
+
+class TestAsha:
+    def run_asha(self, direction, metric_fn, num_trials=9):
+        """Drive ASHA synchronously like one executor would."""
+        opt = wire(Asha(reduction_factor=3, resource_min=1, resource_max=9, seed=1),
+                   space(), num_trials, direction=direction)
+        finished = []
+        trial, last = opt.get_suggestion(), None
+        steps = 0
+        while trial is not None and steps < 200:
+            steps += 1
+            if trial == "IDLE":
+                trial = opt.get_suggestion(last)
+                continue
+            opt.trial_store[trial.trial_id] = trial
+            metric = metric_fn(trial.params)
+            finalize(opt, trial, metric)
+            finished.append(trial)
+            last = trial
+            trial = opt.get_suggestion(last)
+        return opt, finished
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="reduction_factor"):
+            Asha(reduction_factor=1)
+        with pytest.raises(ValueError, match="resource"):
+            Asha(resource_min=4, resource_max=2)
+        opt = Asha(reduction_factor=3, resource_min=1, resource_max=9)
+        with pytest.raises(ValueError, match="num_trials"):
+            wire(opt, space(), 3)  # needs >= 9
+
+    def test_promotion_ladder_max_direction(self):
+        opt, finished = self.run_asha("max", lambda p: p["lr"])
+        budgets = [t.params["budget"] for t in finished]
+        assert budgets.count(1) == 9  # all rung-0 samples run
+        assert budgets.count(3) >= 1  # promotions happened
+        assert budgets.count(9) >= 1  # someone reached the top
+        # The trial promoted to the top should be among the best rung-0 lr's.
+        top = [t for t in finished if t.params["budget"] == 9][0]
+        rung0_lrs = sorted((t.params["lr"] for t in finished if t.params["budget"] == 1),
+                           reverse=True)
+        assert top.params["lr"] in rung0_lrs[:3]
+
+    def test_promotion_respects_min_direction(self):
+        # With direction=min the LOWEST lr must be promoted (the reference's
+        # hardcoded descending sort got this wrong; SURVEY.md §2.5).
+        opt, finished = self.run_asha("min", lambda p: p["lr"])
+        top = [t for t in finished if t.params["budget"] == 9][0]
+        rung0_lrs = sorted(t.params["lr"] for t in finished if t.params["budget"] == 1)
+        assert top.params["lr"] in rung0_lrs[:3]
+
+
+class TestEarlyStop:
+    def make_finalized(self, histories):
+        out = []
+        for h in histories:
+            t = Trial({"lr": float(len(out))})
+            for i, m in enumerate(h):
+                t.append_metric(m, step=i)
+            t.final_metric = h[-1]
+            out.append(t)
+        return out
+
+    def test_median_rule_stops_bad_trial_max(self):
+        finalized = self.make_finalized([[0.5, 0.6, 0.7], [0.6, 0.7, 0.8], [0.4, 0.5, 0.6]])
+        bad = Trial({"lr": 9.0})
+        for i, m in enumerate([0.1, 0.1, 0.1]):
+            bad.append_metric(m, step=i)
+        good = Trial({"lr": 8.0})
+        for i, m in enumerate([0.9, 0.9, 0.9]):
+            good.append_metric(m, step=i)
+        to_check = {bad.trial_id: bad, good.trial_id: good}
+        stopped = MedianStoppingRule.earlystop_check(to_check, finalized, "max")
+        assert bad in stopped and good not in stopped
+
+    def test_median_rule_min_direction(self):
+        finalized = self.make_finalized([[0.5, 0.4], [0.6, 0.5], [0.4, 0.3]])
+        bad = Trial({"lr": 9.0})
+        bad.append_metric(0.9, step=0)
+        bad.append_metric(0.9, step=1)
+        stopped = MedianStoppingRule.earlystop_check({bad.trial_id: bad}, finalized, "min")
+        assert bad in stopped
+
+    def test_no_history_not_stopped(self):
+        finalized = self.make_finalized([[0.5]])
+        fresh = Trial({"lr": 1.0})
+        assert MedianStoppingRule.earlystop_check({fresh.trial_id: fresh}, finalized, "max") == []
+
+    def test_nostop(self):
+        t = Trial({"lr": 1.0})
+        t.append_metric(0.0, step=0)
+        assert NoStoppingRule.earlystop_check({t.trial_id: t}, [], "max") == []

@@ -1,0 +1,117 @@
+"""Searchspace unit tests (model: reference `maggy/tests/test_searchspace.py:24-77`)."""
+
+import numpy as np
+import pytest
+
+from maggy_tpu.searchspace import Searchspace
+
+
+def make_space():
+    return Searchspace(
+        lr=("DOUBLE", [1e-4, 1e-1]),
+        layers=("INTEGER", [1, 8]),
+        pool=("DISCRETE", [2, 3, 4]),
+        act=("CATEGORICAL", ["relu", "gelu", "tanh"]),
+    )
+
+
+class TestValidation:
+    def test_reserved_name_rejected(self):
+        with pytest.raises(ValueError, match="reserved"):
+            Searchspace(budget=("DOUBLE", [0, 1]))
+
+    def test_duplicate_rejected(self):
+        sp = Searchspace(lr=("DOUBLE", [0, 1]))
+        with pytest.raises(ValueError, match="already exists"):
+            sp.add("lr", ("DOUBLE", [0, 1]))
+
+    def test_bad_tuple_arity(self):
+        with pytest.raises(ValueError, match="pair"):
+            Searchspace(lr=("DOUBLE", [0, 1], "extra"))
+
+    def test_unknown_type(self):
+        with pytest.raises(ValueError, match="type"):
+            Searchspace(lr=("FLOAT", [0, 1]))
+
+    def test_empty_region(self):
+        with pytest.raises(ValueError, match="non-empty"):
+            Searchspace(lr=("DISCRETE", []))
+
+    def test_bound_ordering(self):
+        with pytest.raises(ValueError, match="lower bound"):
+            Searchspace(lr=("DOUBLE", [1.0, 0.5]))
+
+    def test_integer_type_check(self):
+        with pytest.raises(ValueError, match="bounds"):
+            Searchspace(n=("INTEGER", [0.5, 2]))
+
+    def test_categorical_requires_strings(self):
+        with pytest.raises(ValueError, match="strings"):
+            Searchspace(act=("CATEGORICAL", [1, 2]))
+
+
+class TestSampling:
+    def test_random_values_in_bounds(self):
+        sp = make_space()
+        rng = np.random.default_rng(0)
+        for params in sp.get_random_parameter_values(50, rng=rng):
+            assert 1e-4 <= params["lr"] <= 1e-1
+            assert 1 <= params["layers"] <= 8 and isinstance(params["layers"], int)
+            assert params["pool"] in [2, 3, 4]
+            assert params["act"] in ["relu", "gelu", "tanh"]
+
+    def test_seeded_reproducibility(self):
+        sp = make_space()
+        a = sp.get_random_parameter_values(10, rng=np.random.default_rng(42))
+        b = sp.get_random_parameter_values(10, rng=np.random.default_rng(42))
+        assert a == b
+
+    def test_grid(self):
+        sp = Searchspace(pool=("DISCRETE", [2, 3]), act=("CATEGORICAL", ["relu", "gelu"]))
+        grid = sp.grid()
+        assert len(grid) == 4
+        assert {"pool": 2, "act": "gelu"} in grid
+
+    def test_grid_rejects_continuous(self):
+        with pytest.raises(ValueError, match="Grid"):
+            make_space().grid()
+
+
+class TestCodec:
+    def test_roundtrip(self):
+        sp = make_space()
+        rng = np.random.default_rng(7)
+        for params in sp.get_random_parameter_values(100, rng=rng):
+            x = sp.transform(params)
+            assert x.shape == (4,)
+            assert np.all((x >= 0) & (x <= 1))
+            back = sp.inverse_transform(x)
+            assert back["layers"] == params["layers"]
+            assert back["pool"] == params["pool"]
+            assert back["act"] == params["act"]
+            assert abs(back["lr"] - params["lr"]) < 1e-12
+
+    def test_batch_shapes(self):
+        sp = make_space()
+        params = sp.get_random_parameter_values(5, rng=np.random.default_rng(0))
+        X = sp.transform_batch(params)
+        assert X.shape == (5, 4)
+        assert sp.inverse_transform_batch(X)[0]["act"] == params[0]["act"]
+
+    def test_var_types(self):
+        assert make_space().var_types() == ["c", "c", "u", "u"]
+
+
+class TestProtocol:
+    def test_container(self):
+        sp = make_space()
+        assert len(sp) == 4
+        assert "lr" in sp and "nope" not in sp
+        assert sp["pool"] == [2, 3, 4]
+        names = [item["name"] for item in sp]
+        assert names == ["lr", "layers", "pool", "act"]
+
+    def test_dict_roundtrip(self):
+        sp = make_space()
+        sp2 = Searchspace.from_dict(sp.to_dict())
+        assert sp2.to_dict() == sp.to_dict()

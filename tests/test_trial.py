@@ -1,0 +1,59 @@
+"""Trial unit tests (model: reference `maggy/tests/test_trial.py:25-48`)."""
+
+import json
+
+from maggy_tpu.trial import Trial
+
+
+def test_id_stable_and_deterministic():
+    t1 = Trial({"lr": 0.01, "layers": 3})
+    t2 = Trial({"layers": 3, "lr": 0.01})
+    assert t1.trial_id == t2.trial_id
+    assert len(t1.trial_id) == 16
+
+
+def test_different_params_different_id():
+    assert Trial({"lr": 0.01}).trial_id != Trial({"lr": 0.02}).trial_id
+
+
+def test_ablation_id_hashes_only_ablated_components():
+    a = Trial({"ablated_feature": "age", "other": 1}, trial_type="ablation")
+    b = Trial({"ablated_feature": "age", "other": 2}, trial_type="ablation")
+    assert a.trial_id == b.trial_id
+
+
+def test_metric_append_dedup_by_step():
+    t = Trial({"lr": 0.1})
+    assert t.append_metric(0.5, step=0)
+    assert not t.append_metric(0.6, step=0)  # duplicate step dropped
+    assert t.append_metric(0.7, step=1)
+    assert t.metric_history == [0.5, 0.7]
+    assert t.step_history == [0, 1]
+
+
+def test_metric_append_auto_step():
+    t = Trial({"lr": 0.1})
+    t.append_metric(1.0)
+    t.append_metric(2.0)
+    assert t.step_history == [0, 1]
+
+
+def test_json_roundtrip():
+    t = Trial({"lr": 0.01, "act": "relu"})
+    t.set_status(Trial.RUNNING)
+    t.append_metric(0.9, step=5)
+    t.final_metric = 0.95
+    blob = t.to_json()
+    back = Trial.from_json(blob)
+    assert back.trial_id == t.trial_id
+    assert back.status == Trial.RUNNING
+    assert back.metric_dict == {5: 0.9}
+    assert back.final_metric == 0.95
+    json.loads(blob)  # valid json
+
+
+def test_early_stop_flag():
+    t = Trial({"lr": 0.1})
+    assert not t.get_early_stop()
+    t.set_early_stop()
+    assert t.get_early_stop()
