@@ -1,0 +1,58 @@
+"""Ablator plugin contract (reference `maggy/ablation/ablator/abstractablator.py:20-86`)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+from maggy_tpu.trial import Trial
+
+
+class AbstractAblator(ABC):
+    """Also satisfies the slice of the controller interface the
+    OptimizationDriver drives (get_suggestion/_initialize/_strip_budget), so
+    ablation studies reuse the whole HPO scheduling machinery (the reference
+    does the same by subclassing the driver, `ablation_driver.py:108-109`)."""
+
+    def __init__(self, ablation_study, final_store: Optional[List[Trial]] = None):
+        self.ablation_study = ablation_study
+        self.final_store = final_store if final_store is not None else []
+        self.trial_buffer: List[Trial] = []
+        self.pruner = None
+        self.trial_store = {}
+        self.searchspace = None
+        self.num_trials = 0
+        self.direction = "max"
+
+    @abstractmethod
+    def get_number_of_trials(self) -> int:
+        ...
+
+    @abstractmethod
+    def initialize(self) -> None:
+        """Fill the trial buffer with the full ablation schedule."""
+
+    @abstractmethod
+    def get_trial(self, last_trial: Optional[Trial] = None) -> Optional[Trial]:
+        """Pop the next trial, or None when the study is complete."""
+
+    def finalize_experiment(self, trials: List[Trial]) -> None:
+        pass
+
+    # ----------------------------------------------- controller-shim methods
+
+    def _initialize(self, exp_dir: Optional[str] = None) -> None:
+        self.initialize()
+
+    def _finalize_experiment(self, trials: List[Trial]) -> None:
+        self.finalize_experiment(trials)
+
+    def get_suggestion(self, trial: Optional[Trial] = None) -> Optional[Trial]:
+        return self.get_trial(trial)
+
+    def init_pruner(self):
+        return None
+
+    @staticmethod
+    def _strip_budget(params):
+        return {k: v for k, v in params.items() if k != "budget"}

@@ -46,7 +46,11 @@ class OptimizationConfig(LagomConfig):
     es_policy: Union[str, Any] = constants.DEFAULT_ES_POLICY
     num_workers: int = 1
     seed: Optional[int] = None
-    # Per-trial device assignment: how many TPU chips each trial gets.
+    # Runner substrate: "thread" (in-process), "process" (one JAX runtime
+    # per trial), "tpu" (processes pinned to disjoint chip sub-slices).
+    pool: str = "thread"
+    # Per-trial device assignment: how many TPU chips each trial gets
+    # (used by pool="tpu").
     chips_per_trial: int = 1
     # Experiment artifact root; defaults to the environment's base dir.
     experiment_dir: Optional[str] = None
@@ -54,23 +58,23 @@ class OptimizationConfig(LagomConfig):
     def __post_init__(self):
         if self.direction not in ("max", "min"):
             raise ValueError("direction must be 'max' or 'min', got {!r}".format(self.direction))
+        if self.pool not in ("thread", "process", "tpu"):
+            raise ValueError("pool must be 'thread', 'process', or 'tpu'")
 
 
 @dataclass
-class AblationConfig(LagomConfig):
-    """Ablation-study experiment (reference `experiment_config.py:52-66`)."""
+class AblationConfig(OptimizationConfig):
+    """Ablation-study experiment (reference `experiment_config.py:52-66`).
+
+    Subclasses OptimizationConfig for the shared driver-plumbing fields
+    (num_workers/pool/direction/...); `optimizer` and the early-stop knobs
+    are ignored — ablation schedules are fixed and never early-stop
+    (reference `ablation_driver.py:33`).
+    """
 
     ablation_study: Any = None
     ablator: Union[str, Any] = "loco"
-    direction: str = "max"
-    optimization_key: str = "metric"
-    num_workers: int = 1
-    chips_per_trial: int = 1
-    experiment_dir: Optional[str] = None
-
-    def __post_init__(self):
-        if self.direction not in ("max", "min"):
-            raise ValueError("direction must be 'max' or 'min', got {!r}".format(self.direction))
+    es_policy: str = "none"
 
 
 @dataclass

@@ -1,0 +1,4 @@
+from maggy_tpu.core.driver.driver import Driver
+from maggy_tpu.core.driver.optimization_driver import OptimizationDriver
+
+__all__ = ["Driver", "OptimizationDriver"]

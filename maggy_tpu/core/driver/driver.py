@@ -1,0 +1,171 @@
+"""Abstract experiment driver: the control-plane kernel.
+
+Parity: reference `maggy/core/experiment_driver/driver.py` — owns the RPC
+server + per-experiment secret (:54-57,74-79), a message queue consumed by a
+daemon worker thread dispatching to registered callbacks (:59-61,140-158),
+and the experiment lifecycle `run_experiment`: startup callback -> register
+experiment -> start server+worker -> fan out executors -> final callback ->
+stop (:81-117).
+
+Redesign: the Spark `sc.parallelize(...).foreachPartition` fan-out
+(`driver.py:96-106`) is replaced by a pluggable `RunnerPool` that launches N
+trial-runner workers (threads in-process, local processes, or TPU-VM agent
+processes pinned to chip sub-slices).
+"""
+
+from __future__ import annotations
+
+import queue
+import secrets as pysecrets
+import threading
+import time
+import traceback
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Dict, Optional
+
+from maggy_tpu.core.environment import EnvSing
+
+
+class Driver(ABC):
+    def __init__(self, config, app_id: str, run_id: int):
+        self.config = config
+        self.app_id = app_id
+        self.run_id = run_id
+        self.name = config.name
+        self.description = getattr(config, "description", "")
+        self.hb_interval = getattr(config, "hb_interval", 1.0)
+        self.env = EnvSing.get_instance()
+        self.secret = pysecrets.token_hex(16)
+
+        self.server = self._make_server()
+        self.server.attach_driver(self)
+        self.server_addr: Optional[tuple] = None
+
+        self._message_q: "queue.Queue[Dict[str, Any]]" = queue.Queue()
+        self.message_callbacks: Dict[str, Callable[[Dict[str, Any]], None]] = {}
+        self.worker_done = False
+        self.experiment_done = False
+        self._worker_thread: Optional[threading.Thread] = None
+        self.executor_logs: list = []
+        self._log_lock = threading.Lock()
+        self.exception: Optional[BaseException] = None
+
+        self.exp_dir = self.env.register_experiment(
+            app_id, run_id,
+            {"name": self.name, "description": self.description,
+             "type": type(self).__name__},
+            base_dir=getattr(config, "experiment_dir", None),
+        )
+        self.log_file = None
+        self._register_msg_callbacks()
+
+    # ------------------------------------------------------------- template
+
+    @abstractmethod
+    def _make_server(self):
+        ...
+
+    @abstractmethod
+    def _make_runner_pool(self):
+        ...
+
+    @abstractmethod
+    def _executor_fn(self, train_fn) -> Callable:
+        """Build the worker closure each runner executes (the reference's
+        `_patching_fn`, `driver.py:160-162`)."""
+
+    def _exp_startup_callback(self) -> None:
+        pass
+
+    def _exp_final_callback(self, job_end: float, exp_json: dict) -> Any:
+        return None
+
+    def _exp_exception_callback(self, exc: BaseException) -> None:
+        raise exc
+
+    @abstractmethod
+    def _register_msg_callbacks(self) -> None:
+        ...
+
+    # ------------------------------------------------------------ lifecycle
+
+    def run_experiment(self, train_fn: Callable) -> Any:
+        job_start = time.time()
+        result = None
+        try:
+            self._exp_startup_callback()
+            self.init()
+            pool = self._make_runner_pool()
+            # Fan out the executor wrapper to all runners; BLOCKS until all
+            # workers return (the reference's foreachPartition semantics).
+            pool.run(self._executor_fn(train_fn))
+            job_end = time.time()
+            # A worker-callback failure must surface BEFORE finalization, or
+            # the experiment would transiently be marked FINISHED with a
+            # bogus result.json.
+            if self.exception is not None:
+                raise self.exception
+            result = self._exp_final_callback(job_end, {})
+            return result
+        except BaseException as exc:  # noqa: BLE001 - driver must always clean up
+            self._exp_exception_callback(exc)
+        finally:
+            self.stop()
+
+    def init(self) -> None:
+        self.server_addr = self.env.connect_host(self.server)
+        self._start_worker()
+
+    def _start_worker(self) -> None:
+        def worker():
+            while not self.worker_done:
+                try:
+                    msg = self._message_q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                callback = self.message_callbacks.get(msg.get("type"))
+                if callback is None:
+                    continue
+                try:
+                    callback(msg)
+                except Exception as exc:  # noqa: BLE001 - keep worker alive, surface later
+                    self.exception = exc
+                    self._log("worker callback error: {}".format(traceback.format_exc()))
+                    self.experiment_done = True
+
+        self._worker_thread = threading.Thread(target=worker, daemon=True, name="driver-worker")
+        self._worker_thread.start()
+
+    def stop(self) -> None:
+        self.worker_done = True
+        self.experiment_done = True
+        if self._worker_thread is not None:
+            self._worker_thread.join(timeout=5)
+        self.server.stop()
+
+    # ------------------------------------------------------------- services
+
+    def enqueue(self, msg: Dict[str, Any]) -> None:
+        self._message_q.put(msg)
+
+    def get_trial(self, trial_id: str):
+        return None
+
+    def progress_snapshot(self) -> Dict[str, Any]:
+        return {}
+
+    def _log(self, msg: str) -> None:
+        line = "{} ({}/{}): {}".format(
+            time.strftime("%Y-%m-%d %H:%M:%S"), self.app_id, self.run_id, msg
+        )
+        with self._log_lock:
+            try:
+                with self.env.open_file(self.exp_dir + "/maggy.log", "a") as f:
+                    f.write(line + "\n")
+            except OSError:
+                pass
+
+    def add_executor_logs(self, logs) -> None:
+        if logs:
+            with self._log_lock:
+                self.executor_logs.extend(logs)

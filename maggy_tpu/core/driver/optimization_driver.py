@@ -1,0 +1,297 @@
+"""HPO experiment driver.
+
+Parity: reference `maggy/core/experiment_driver/optimization_driver.py` —
+optimizer registry (:35-43), executor clamping (:57-59), pruner/gridsearch
+num_trials overrides (:63-69), controller wiring to trial/final stores
+(:87-93), message callbacks METRIC/BLACK/FINAL/IDLE/REG (:331-457), result
+aggregation best/worst/avg (:247-307), finalize writing result.json +
+experiment summary (:158-194).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from maggy_tpu import util
+from maggy_tpu.config import OptimizationConfig
+from maggy_tpu.core.driver.driver import Driver
+from maggy_tpu.core.executors.trial_executor import trial_executor_fn
+from maggy_tpu.core.rpc import OptimizationServer
+from maggy_tpu.core.runner_pool import ThreadRunnerPool
+from maggy_tpu.earlystop import MedianStoppingRule, NoStoppingRule
+from maggy_tpu.optimizers import Asha, GridSearch, RandomSearch, SingleRun
+from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
+from maggy_tpu.optimizers.bayes import GP, TPE
+from maggy_tpu.trial import Trial
+
+CONTROLLER_REGISTRY = {
+    "randomsearch": RandomSearch,
+    "gridsearch": GridSearch,
+    "asha": Asha,
+    "tpe": TPE,
+    "gp": GP,
+    "none": SingleRun,
+}
+
+ES_REGISTRY = {"median": MedianStoppingRule, "none": NoStoppingRule}
+
+
+class OptimizationDriver(Driver):
+    controller_dict = CONTROLLER_REGISTRY
+
+    def __init__(self, config: OptimizationConfig, app_id: str, run_id: int):
+        self.controller = self._init_controller(config)
+        # Pruner must exist BEFORE sizing the schedule: it owns num_trials
+        # when multi-fidelity (reference `optimization_driver.py:63-65`).
+        self.controller.init_pruner()
+        self.num_trials = self._resolve_num_trials(config)
+        self.num_executors = min(config.num_workers, self.num_trials)
+        super().__init__(config, app_id, run_id)
+
+        # Trial bookkeeping shared with the server thread.
+        self._trial_store: Dict[str, Trial] = {}
+        self._final_store: List[Trial] = []
+        self._store_lock = threading.RLock()
+        self.earlystop_check = self._init_earlystop(config)
+        self.es_interval = config.es_interval
+        self.es_min = config.es_min
+        self.direction = config.direction
+        self.optimization_key = config.optimization_key
+
+        # Wire the controller (reference `optimization_driver.py:87-93`).
+        self.controller.searchspace = config.searchspace
+        self.controller.num_trials = self.num_trials
+        self.controller.trial_store = self._trial_store
+        self.controller.final_store = self._final_store
+        self.controller.direction = config.direction
+        self.controller._initialize(exp_dir=self.exp_dir)
+
+        self.result = {"best_id": None, "best_val": None, "best_hp": None,
+                       "worst_id": None, "worst_val": None, "worst_hp": None,
+                       "avg": None, "num_trials": 0, "early_stopped": 0}
+        self.job_start: Optional[float] = None
+        self.maggy_log = ""
+
+    # --------------------------------------------------------------- set up
+
+    @staticmethod
+    def _init_controller(config) -> AbstractOptimizer:
+        opt = config.optimizer
+        if isinstance(opt, str):
+            key = opt.lower()
+            if key not in CONTROLLER_REGISTRY:
+                raise ValueError(
+                    "Unknown optimizer '{}'; choose from {} or pass an "
+                    "AbstractOptimizer instance.".format(opt, sorted(CONTROLLER_REGISTRY))
+                )
+            return CONTROLLER_REGISTRY[key](seed=config.seed) if key != "none" \
+                else SingleRun(seed=config.seed)
+        if opt is None:
+            return SingleRun(seed=config.seed)
+        if not isinstance(opt, AbstractOptimizer):
+            raise TypeError(
+                "optimizer must be a registry name or AbstractOptimizer, got {}".format(type(opt))
+            )
+        return opt
+
+    def _resolve_num_trials(self, config) -> int:
+        # Pruner owns the schedule; gridsearch computes from the space
+        # (reference `optimization_driver.py:63-69`).
+        if self.controller.pruner is not None:
+            return self.controller.pruner.num_trials()
+        if isinstance(self.controller, GridSearch):
+            return GridSearch.get_num_trials(config.searchspace)
+        return config.num_trials
+
+    @staticmethod
+    def _init_earlystop(config):
+        pol = config.es_policy
+        if isinstance(pol, str):
+            if pol.lower() not in ES_REGISTRY:
+                raise ValueError("Unknown es_policy '{}'".format(pol))
+            return ES_REGISTRY[pol.lower()]
+        return pol
+
+    def _make_server(self):
+        # Barrier sized to the CLAMPED worker count, and keyed by the
+        # driver's per-experiment secret.
+        return OptimizationServer(self.num_executors, secret=self.secret)
+
+    def _make_runner_pool(self):
+        pool = getattr(self.config, "pool", "thread")
+        if pool == "thread":
+            return ThreadRunnerPool(self.num_executors)
+        from maggy_tpu.core.runner_pool import ProcessRunnerPool, TPURunnerPool
+
+        if pool == "process":
+            return ProcessRunnerPool(self.num_executors)
+        if pool == "tpu":
+            return TPURunnerPool(self.num_executors,
+                                 chips_per_trial=self.config.chips_per_trial)
+        raise ValueError("Unknown pool type {!r}".format(pool))
+
+    def _executor_fn(self, train_fn):
+        return trial_executor_fn(
+            server_addr=self.server_addr,
+            secret=self.secret_for_clients(),
+            hb_interval=self.hb_interval,
+            exp_dir=self.exp_dir,
+            optimization_key=self.optimization_key,
+            train_fn=train_fn,
+            trial_type="optimization",
+        )
+
+    def secret_for_clients(self) -> str:
+        return self.server.secret_hex
+
+    # ------------------------------------------------------------ callbacks
+
+    def _register_msg_callbacks(self) -> None:
+        self.message_callbacks.update(
+            METRIC=self._metric_msg_callback,
+            BLACK=self._blacklist_msg_callback,
+            FINAL=self._final_msg_callback,
+            IDLE=self._idle_msg_callback,
+            REG=self._register_msg_callback,
+        )
+
+    def get_trial(self, trial_id):
+        with self._store_lock:
+            return self._trial_store.get(trial_id)
+
+    def _metric_msg_callback(self, msg) -> None:
+        """Append heartbeat metric; early-stop check every es_interval steps
+        once es_min trials finalized (reference :331-361)."""
+        self.add_executor_logs(msg.get("logs"))
+        trial = self.get_trial(msg.get("trial_id"))
+        if trial is None or msg.get("value") is None:
+            return
+        appended = trial.append_metric(msg["value"], msg.get("step"))
+        if not appended:
+            return
+        with self._store_lock:
+            n_final = len(self._final_store)
+        if n_final >= self.es_min and len(trial.step_history) % self.es_interval == 0:
+            stopped = self.earlystop_check.earlystop_check(
+                {trial.trial_id: trial}, list(self._final_store), self.direction
+            )
+            for t in stopped:
+                t.set_early_stop()
+                self.result["early_stopped"] += 1
+
+    def _blacklist_msg_callback(self, msg) -> None:
+        """Executor died and re-registered: requeue its trial (reference
+        :363-367 + `rpc.py:308-326`)."""
+        trial = self.get_trial(msg["trial_id"])
+        if trial is not None:
+            trial.set_status(Trial.SCHEDULED)
+            self.server.reservations.assign_trial(msg["partition_id"], trial.trial_id)
+            self._log("executor {} restarted; trial {} requeued".format(
+                msg["partition_id"], msg["trial_id"]))
+
+    def _final_msg_callback(self, msg) -> None:
+        """Finalize trial, persist artifacts, hand the executor new work
+        (reference :369-417)."""
+        self.add_executor_logs(msg.get("logs"))
+        trial = self.get_trial(msg.get("trial_id"))
+        if trial is None:
+            return
+        with trial.lock:
+            if msg.get("error"):
+                trial.status = Trial.ERROR
+                trial.final_metric = None
+            else:
+                trial.status = Trial.FINALIZED
+                trial.final_metric = float(msg["value"])
+            trial.duration = time.time() - trial.start if trial.start else None
+        with self._store_lock:
+            self._trial_store.pop(trial.trial_id, None)
+            self._final_store.append(trial)
+        if trial.status == Trial.ERROR and self.controller.pruner is not None:
+            report = getattr(self.controller.pruner, "report_failure", None)
+            if report:
+                report(trial.trial_id)
+        self._update_result(trial)
+        self.env.dump(trial.to_json(),
+                      "{}/{}/trial.json".format(self.exp_dir, trial.trial_id))
+        self._assign_next(msg["partition_id"], trial)
+
+    def _register_msg_callback(self, msg) -> None:
+        self._assign_next(msg["partition_id"], None)
+
+    def _idle_msg_callback(self, msg) -> None:
+        """Re-poll the controller after a short tick (reference :419-439)."""
+        self._assign_next(msg["partition_id"], msg.get("last_trial"))
+
+    def _assign_next(self, partition_id: int, last_trial: Optional[Trial]) -> None:
+        # The controller, not a trial count, decides when the experiment is
+        # over: multi-fidelity schedules (ASHA promotions, Hyperband brackets)
+        # legitimately run more trials than `num_trials` rung-0 samples.
+        if self.experiment_done:
+            return
+        suggestion = self.controller.get_suggestion(last_trial)
+        if suggestion is None:
+            self.experiment_done = True
+        elif suggestion == "IDLE":
+            # Requeue after the idle tick from a timer, NOT by sleeping on the
+            # single worker thread (64 idle runners would stall METRIC/FINAL
+            # processing by ~0.6 s per cycle otherwise).
+            msg = {"type": "IDLE", "partition_id": partition_id, "last_trial": None}
+            timer = threading.Timer(0.1, self.enqueue, args=(msg,))
+            timer.daemon = True
+            timer.start()
+        else:
+            with self._store_lock:
+                self._trial_store[suggestion.trial_id] = suggestion
+            suggestion.set_status(Trial.SCHEDULED)
+            self.server.reservations.assign_trial(partition_id, suggestion.trial_id)
+
+    # -------------------------------------------------------------- results
+
+    def _update_result(self, trial: Trial) -> None:
+        if trial.final_metric is None:
+            return
+        metric, maximize = trial.final_metric, self.direction == "max"
+        r = self.result
+        r["num_trials"] += 1
+        if r["best_val"] is None or (metric > r["best_val"] if maximize else metric < r["best_val"]):
+            r.update(best_id=trial.trial_id, best_val=metric,
+                     best_hp=self.controller._strip_budget(trial.params))
+        if r["worst_val"] is None or (metric < r["worst_val"] if maximize else metric > r["worst_val"]):
+            r.update(worst_id=trial.trial_id, worst_val=metric,
+                     worst_hp=self.controller._strip_budget(trial.params))
+        n = r["num_trials"]
+        r["avg"] = metric if r["avg"] is None else r["avg"] + (metric - r["avg"]) / n
+
+    def _exp_startup_callback(self) -> None:
+        self.job_start = time.time()
+        util.write_hparams_config(self.exp_dir, self.config.searchspace)
+
+    def _exp_final_callback(self, job_end, exp_json):
+        with self._store_lock:
+            finalized = list(self._final_store)
+        self.controller._finalize_experiment(finalized)
+        duration = job_end - (self.job_start or job_end)
+        self.result["duration_s"] = duration
+        self.env.dump(json.dumps(self.result, indent=2, default=str),
+                      self.exp_dir + "/result.json")
+        self.env.finalize_experiment(
+            self.exp_dir, "FINISHED",
+            {"result": {k: self.result[k] for k in
+                        ("best_id", "best_val", "avg", "num_trials", "early_stopped")}},
+        )
+        return dict(self.result)
+
+    def _exp_exception_callback(self, exc) -> None:
+        self.env.finalize_experiment(self.exp_dir, "FAILED", {"error": repr(exc)})
+        raise exc
+
+    def progress_snapshot(self) -> Dict[str, Any]:
+        with self._store_lock:
+            done = len(self._final_store)
+        return {"num_trials": self.num_trials, "finalized": done,
+                "best_val": self.result["best_val"],
+                "early_stopped": self.result["early_stopped"]}

@@ -1,0 +1,4 @@
+from maggy_tpu.core.environment.abstractenvironment import AbstractEnv
+from maggy_tpu.core.environment.singleton import EnvSing
+
+__all__ = ["AbstractEnv", "EnvSing"]

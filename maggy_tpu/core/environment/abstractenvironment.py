@@ -1,0 +1,206 @@
+"""Environment abstraction: filesystem + platform services.
+
+Parity: reference `maggy/core/environment/abstractenvironment.py:20-169`
+(27-method interface over HDFS/Hopsworks). Redesigned: a compact fs/registry
+interface whose default implementation is a LOCAL filesystem that works out
+of the box — unlike the reference, which hard-fails outside Hopsworks
+(`singleton.py:36-39`). A GCS implementation slots in for TPU pods (shared
+experiment dirs across VMs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+from abc import ABC
+from typing import Any, Dict, List, Optional
+
+
+class AbstractEnv(ABC):
+    """Filesystem + experiment-registry services used by driver & executors."""
+
+    # ------------------------------------------------------------------- fs
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def mkdir(self, path: str) -> None:
+        raise NotImplementedError
+
+    def dump(self, data: str, path: str) -> None:
+        raise NotImplementedError
+
+    def load(self, path: str) -> str:
+        raise NotImplementedError
+
+    def open_file(self, path: str, mode: str = "r"):
+        raise NotImplementedError
+
+    def isdir(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def ls(self, path: str) -> List[str]:
+        raise NotImplementedError
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        raise NotImplementedError
+
+    # -------------------------------------------------------------- registry
+
+    def experiment_base_dir(self) -> str:
+        raise NotImplementedError
+
+    def register_experiment(self, app_id: str, run_id: int, meta: Dict[str, Any],
+                            base_dir: Optional[str] = None) -> str:
+        """Create the experiment directory and persist initial metadata;
+        returns the experiment dir (reference `util.py:264-279`)."""
+        raise NotImplementedError
+
+    def update_experiment(self, exp_dir: str, meta: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def finalize_experiment(self, exp_dir: str, state: str, meta: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ networking
+
+    def get_ip_address(self) -> str:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            ip = s.getsockname()[0]
+        except OSError:
+            ip = "127.0.0.1"
+        finally:
+            s.close()
+        return ip
+
+    def connect_host(self, server, host: Optional[str] = None):
+        """Bind the control-plane server and return (host, port). Platform
+        implementations may additionally publish the address (the reference
+        POSTs it to Hopsworks REST, `hopsworks.py:129-178`)."""
+        return server.start(host=host or "127.0.0.1")
+
+    @staticmethod
+    def str_or_byte(value):
+        return value.decode() if isinstance(value, bytes) else value
+
+
+class LocalEnv(AbstractEnv):
+    """Local-filesystem environment (default). Experiment artifacts live
+    under ``base_dir`` (default ``~/maggy_tpu_experiments`` or
+    ``$MAGGY_TPU_BASE_DIR``)."""
+
+    def __init__(self, base_dir: Optional[str] = None):
+        self.base_dir = base_dir or os.environ.get(
+            "MAGGY_TPU_BASE_DIR",
+            os.path.join(os.path.expanduser("~"), "maggy_tpu_experiments"),
+        )
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def mkdir(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def dump(self, data: str, path: str) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(data)
+
+    def load(self, path: str) -> str:
+        with open(path) as f:
+            return f.read()
+
+    def open_file(self, path: str, mode: str = "r"):
+        if "w" in mode or "a" in mode:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        return open(path, mode)
+
+    def isdir(self, path: str) -> bool:
+        return os.path.isdir(path)
+
+    def ls(self, path: str) -> List[str]:
+        return sorted(os.listdir(path)) if os.path.isdir(path) else []
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        if os.path.isdir(path):
+            if recursive:
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                os.rmdir(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def experiment_base_dir(self) -> str:
+        return self.base_dir
+
+    def register_experiment(self, app_id: str, run_id: int, meta: Dict[str, Any],
+                            base_dir: Optional[str] = None) -> str:
+        exp_dir = os.path.join(base_dir or self.base_dir, "{}_{}".format(app_id, run_id))
+        self.mkdir(exp_dir)
+        self.dump(json.dumps({**meta, "state": "RUNNING"}, indent=2, default=str),
+                  os.path.join(exp_dir, "experiment.json"))
+        return exp_dir
+
+    def update_experiment(self, exp_dir: str, meta: Dict[str, Any]) -> None:
+        path = os.path.join(exp_dir, "experiment.json")
+        current = json.loads(self.load(path)) if self.exists(path) else {}
+        current.update(meta)
+        self.dump(json.dumps(current, indent=2, default=str), path)
+
+    def finalize_experiment(self, exp_dir: str, state: str, meta: Dict[str, Any]) -> None:
+        self.update_experiment(exp_dir, {**meta, "state": state})
+
+
+class GCSEnv(LocalEnv):
+    """GCS-backed environment for multi-host TPU pods: same interface over a
+    ``gs://`` base dir via fsspec/gcsfs when available. Falls back to local
+    paths otherwise (gated: gcsfs is not bundled in every image)."""
+
+    def __init__(self, base_dir: str):
+        if not base_dir.startswith("gs://"):
+            raise ValueError("GCSEnv requires a gs:// base dir")
+        try:
+            import gcsfs  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "GCSEnv requires gcsfs; install it or use LocalEnv with an "
+                "NFS-shared base dir."
+            ) from e
+        super().__init__(base_dir)
+        import gcsfs
+
+        self.fs = gcsfs.GCSFileSystem()
+
+    def exists(self, path: str) -> bool:
+        return self.fs.exists(path)
+
+    def mkdir(self, path: str) -> None:
+        pass  # GCS has no directories
+
+    def dump(self, data: str, path: str) -> None:
+        with self.fs.open(path, "w") as f:
+            f.write(data)
+
+    def load(self, path: str) -> str:
+        with self.fs.open(path, "r") as f:
+            return f.read()
+
+    def open_file(self, path: str, mode: str = "r"):
+        return self.fs.open(path, mode)
+
+    def isdir(self, path: str) -> bool:
+        return self.fs.isdir(path)
+
+    def ls(self, path: str) -> List[str]:
+        # gcsfs returns full object paths; the AbstractEnv contract (and
+        # util.build_summary) expects bare entry names like LocalEnv.
+        import os as _os
+
+        return sorted(_os.path.basename(p.rstrip("/")) for p in self.fs.ls(path))
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        self.fs.rm(path, recursive=recursive)

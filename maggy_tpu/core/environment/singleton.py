@@ -1,0 +1,38 @@
+"""Environment singleton.
+
+Parity: reference `maggy/core/environment/singleton.py` — but where the
+reference refuses to run outside Hopsworks (`singleton.py:36-39`), the
+default here is a working LocalEnv; GCS is selected by a ``gs://`` base dir
+(SURVEY.md §7.1 calls this out as a gap not to replicate).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from maggy_tpu.core.environment.abstractenvironment import AbstractEnv, GCSEnv, LocalEnv
+
+
+class EnvSing:
+    _instance: Optional[AbstractEnv] = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_instance(cls) -> AbstractEnv:
+        with cls._lock:
+            if cls._instance is None:
+                base = os.environ.get("MAGGY_TPU_BASE_DIR", "")
+                cls._instance = GCSEnv(base) if base.startswith("gs://") else LocalEnv()
+            return cls._instance
+
+    @classmethod
+    def set_instance(cls, env: AbstractEnv) -> None:
+        with cls._lock:
+            cls._instance = env
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instance = None

@@ -1,0 +1,117 @@
+"""Trial-runner executor loop for HPO / ablation experiments.
+
+Parity: reference `maggy/core/executors/trial_executor.py:32-171` — the
+wrapper each worker runs: connect client -> register -> start heartbeat ->
+loop {get_suggestion -> prepare trial dir + .hparams.json -> call
+train_fn(**params[, reporter]) -> validate/persist return -> catch
+EarlyStopException and use its carried metric -> finalize_metric} until
+GSTOP; ablation mode resolves declarative ablation specs before the call
+(:103-108).
+
+Redesign notes:
+- no `builtins.print` monkey-patching (reference :71-81): the reporter tees
+  to the runner log explicitly; user code gets the reporter for logging.
+- per-trial TPU device pinning happens in the runner pool (process-level),
+  not here: JAX binds devices at process start.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import traceback
+from typing import Callable, Optional, Tuple
+
+from maggy_tpu import util
+from maggy_tpu.core.environment import EnvSing
+from maggy_tpu.core.reporter import Reporter
+from maggy_tpu.core.rpc import Client
+from maggy_tpu.exceptions import EarlyStopException
+
+
+class TrialExecutor:
+    """The worker each runner executes; a module-level class so process
+    pools can pickle it (``train_fn`` must then be module-level too)."""
+
+    def __init__(
+        self,
+        server_addr: Tuple[str, int],
+        secret: str,
+        hb_interval: float,
+        exp_dir: str,
+        optimization_key: str,
+        train_fn: Callable,
+        trial_type: str = "optimization",
+        ablation_resolver: Optional[Callable] = None,
+    ):
+        self.server_addr = server_addr
+        self.secret = secret
+        self.hb_interval = hb_interval
+        self.exp_dir = exp_dir
+        self.optimization_key = optimization_key
+        self.train_fn = train_fn
+        self.trial_type = trial_type
+        self.ablation_resolver = ablation_resolver
+
+    def __call__(self, partition_id: int) -> None:
+        env = EnvSing.get_instance()
+        exp_dir = self.exp_dir
+        task_attempt = int(os.environ.get("MAGGY_TPU_TASK_ATTEMPT", "0"))
+        reporter = Reporter(
+            log_file="{}/executor_{}_{}.log".format(exp_dir, partition_id, task_attempt)
+        )
+        client = Client(self.server_addr, partition_id, task_attempt,
+                        self.hb_interval, self.secret)
+        try:
+            client.register()
+            client.start_heartbeat(reporter)
+            wants_reporter = "reporter" in inspect.signature(self.train_fn).parameters
+
+            while not client.done:
+                trial_id, params = client.get_suggestion()
+                if trial_id is None:
+                    break
+                trial_dir = "{}/{}".format(exp_dir, trial_id)
+                env.mkdir(trial_dir)
+                env.dump(util.json_dumps_safe(params), trial_dir + "/.hparams.json")
+                reporter.reset(trial_id=trial_id)
+
+                call_params = dict(params)
+                if self.trial_type == "ablation":
+                    # Declarative ablation spec -> concrete generators
+                    # (replaces the reference's pickled callables,
+                    # `loco.py:224-259`; SURVEY.md §7 hard part 3).
+                    call_params = self.ablation_resolver(call_params)
+                try:
+                    if wants_reporter:
+                        call_params["reporter"] = reporter
+                    retval = self.train_fn(**call_params)
+                    metric = util.handle_return_val(
+                        retval, trial_dir, self.optimization_key, env
+                    )
+                    client.finalize_metric(metric, reporter)
+                except EarlyStopException as e:
+                    reporter.log("Trial {} early-stopped.".format(trial_id))
+                    env.dump(
+                        util.json_dumps_safe({self.optimization_key: e.metric}),
+                        trial_dir + "/.outputs.json",
+                    )
+                    client.finalize_metric(e.metric, reporter)
+                except Exception:  # noqa: BLE001 - report trial error, keep worker alive
+                    reporter.log(
+                        "Trial {} failed:\n{}".format(trial_id, traceback.format_exc())
+                    )
+                    with reporter.lock:
+                        client._request(
+                            {"type": "FINAL", "trial_id": trial_id, "value": None,
+                             "error": True, "logs": reporter.get_data()["logs"]}
+                        )
+                        reporter.reset()
+        finally:
+            client.stop()
+
+
+def trial_executor_fn(**kwargs) -> TrialExecutor:
+    """Factory kept for parity with the reference's
+    `trial_executor.py:32` naming."""
+    return TrialExecutor(**kwargs)

@@ -1,0 +1,84 @@
+"""Executor-side reporter: bridges user code and the heartbeat thread.
+
+Parity: reference `maggy/core/reporter.py` — `broadcast(metric, step)` with
+type checks, monotonic-step enforcement, latest-value store, and raising
+`EarlyStopException` inside the user's training loop once the driver's STOP
+reply has set the flag (:78-102); `log()` buffered for heartbeat shipping
+(:104-133); `get_data()` drain (:135-141); `reset()` between trials
+(:143-156); `early_stop()` armed only after >=1 reported metric (:158-161).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from maggy_tpu import exceptions
+
+
+class Reporter:
+    def __init__(self, log_file: Optional[str] = None, print_tee: bool = False):
+        self.lock = threading.RLock()
+        self.metric: Optional[float] = None
+        self.step: Optional[int] = None
+        self.trial_id: Optional[str] = None
+        self._stop_flag = False
+        self._log_buffer: List[str] = []
+        self._log_file = log_file
+        self._print_tee = print_tee
+
+    # ------------------------------------------------------------- user API
+
+    def broadcast(self, metric, step: Optional[int] = None) -> None:
+        """Report an interim metric from the training loop. Raises
+        `EarlyStopException` if the driver has flagged this trial."""
+        with self.lock:
+            if not isinstance(metric, (int, float, np.number)) or isinstance(metric, bool):
+                raise exceptions.BroadcastMetricTypeError(metric)
+            if step is not None and (not isinstance(step, (int, np.integer)) or isinstance(step, bool)):
+                raise exceptions.BroadcastStepTypeError(step)
+            if step is None:
+                step = self.step + 1 if self.step is not None else 0
+            elif self.step is not None and step <= self.step:
+                raise exceptions.BroadcastStepValueError(step, self.step)
+            self.metric = float(metric)
+            self.step = int(step)
+            if self._stop_flag:
+                raise exceptions.EarlyStopException(self.metric)
+
+    def log(self, message: str, verbose: bool = True) -> None:
+        with self.lock:
+            self._log_buffer.append(str(message))
+            if self._log_file:
+                try:
+                    with open(self._log_file, "a") as f:
+                        f.write(str(message) + "\n")
+                except OSError:
+                    pass
+        if verbose and self._print_tee:
+            print(message)
+
+    # ------------------------------------------------------- heartbeat side
+
+    def get_data(self) -> Dict[str, Any]:
+        with self.lock:
+            logs = self._log_buffer
+            self._log_buffer = []
+            return {"metric": self.metric, "step": self.step, "logs": logs}
+
+    def early_stop(self) -> None:
+        """Arm the stop flag (only once a metric exists, reference
+        `reporter.py:158-161`)."""
+        with self.lock:
+            if self.metric is not None:
+                self._stop_flag = True
+
+    def reset(self, trial_id: Optional[str] = None) -> None:
+        with self.lock:
+            self.metric = None
+            self.step = None
+            self._stop_flag = False
+            self._log_buffer = []
+            self.trial_id = trial_id

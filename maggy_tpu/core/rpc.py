@@ -1,0 +1,563 @@
+"""DCN control plane: driver <-> trial-runner RPC.
+
+Parity: reference `maggy/core/rpc.py` — message vocabulary
+REG/QUERY/METRIC/FINAL/GET/LOG (+DIST_CONFIG replacing TORCH_CONFIG) with
+replies OK/ERR/STOP/GSTOP/TRIAL (:295-437); `Reservations` barrier registry
+(:35-113); length-prefixed wire protocol (:116-162); select-loop server in a
+daemon thread with per-message shared-secret auth (:250-286); client with a
+dedicated heartbeat socket, reconnect retries, and blocking suggestion polls
+(:440-593); re-registration failure detection queueing BLACK (:308-326).
+
+Deliberate redesigns (SURVEY.md §2.3 "TPU-native equivalent"):
+
+- **msgpack, not cloudpickle**: the reference unpickles network input
+  (`rpc.py:24,146,160`) — arbitrary code execution from any process that
+  knows the port. Here every frame is a fixed-schema msgpack map; trial
+  params are declarative data, never callables.
+- **per-message HMAC** instead of plaintext secret comparison: the secret
+  never travels on the wire after registration.
+- The gradient plane is NOT here: that is `jax.distributed` + XLA collectives
+  over ICI. This layer only brokers the coordinator rendezvous (DIST_CONFIG)
+  the way the reference brokers MASTER_ADDR/PORT (`rpc.py:409-416`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets as pysecrets
+import selectors
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import msgpack
+
+from maggy_tpu import constants
+from maggy_tpu.exceptions import AuthenticationError
+from maggy_tpu.trial import Trial
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 * 1024 * 1024
+
+
+# --------------------------------------------------------------------- wire
+
+
+def _sign(secret: bytes, payload: bytes) -> bytes:
+    return hmac.new(secret, payload, hashlib.sha256).digest()
+
+
+class MessageSocket:
+    """Framed transport: 4-byte big-endian length || 32-byte HMAC || msgpack."""
+
+    @staticmethod
+    def send_msg(sock: socket.socket, msg: Dict[str, Any], secret: bytes) -> None:
+        payload = msgpack.packb(msg, use_bin_type=True)
+        if len(payload) > MAX_FRAME:
+            raise ValueError("Frame too large: {} bytes".format(len(payload)))
+        mac = _sign(secret, payload)
+        sock.sendall(_LEN.pack(len(payload)) + mac + payload)
+
+    @staticmethod
+    def recv_msg(sock: socket.socket, secret: bytes) -> Dict[str, Any]:
+        header = MessageSocket._recv_exact(sock, 4 + 32)
+        (length,) = _LEN.unpack(header[:4])
+        if length > MAX_FRAME:
+            raise AuthenticationError("Oversized frame.")
+        mac = header[4:]
+        payload = MessageSocket._recv_exact(sock, length)
+        if not hmac.compare_digest(mac, _sign(secret, payload)):
+            raise AuthenticationError("Bad message HMAC.")
+        return msgpack.unpackb(payload, raw=False, strict_map_key=False)
+
+    @staticmethod
+    def _recv_exact(sock: socket.socket, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(min(constants.RPC_RECV_BUFSIZE, n - len(buf)))
+            if not chunk:
+                raise ConnectionError("Socket closed mid-frame.")
+            buf.extend(chunk)
+        return bytes(buf)
+
+
+# -------------------------------------------------------------- reservations
+
+
+class Reservations:
+    """Thread-safe registry partition_id -> executor record, with barrier
+    semantics (reference `rpc.py:35-113`)."""
+
+    def __init__(self, required: int):
+        self.required = required
+        self.lock = threading.RLock()
+        self._table: Dict[int, Dict[str, Any]] = {}
+
+    def add(self, meta: Dict[str, Any]) -> None:
+        with self.lock:
+            self._table[int(meta["partition_id"])] = dict(meta)
+
+    def get(self, partition_id: int) -> Optional[Dict[str, Any]]:
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            return dict(rec) if rec else None
+
+    def done(self) -> bool:
+        with self.lock:
+            return len(self._table) >= self.required
+
+    def remaining(self) -> int:
+        with self.lock:
+            return max(0, self.required - len(self._table))
+
+    def assign_trial(self, partition_id: int, trial_id: Optional[str]) -> None:
+        with self.lock:
+            if int(partition_id) in self._table:
+                self._table[int(partition_id)]["trial_id"] = trial_id
+
+    def get_assigned_trial(self, partition_id: int) -> Optional[str]:
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            return rec.get("trial_id") if rec else None
+
+    def all(self) -> Dict[int, Dict[str, Any]]:
+        with self.lock:
+            return {k: dict(v) for k, v in self._table.items()}
+
+
+# --------------------------------------------------------------------- server
+
+
+class Server:
+    """Event-loop RPC server running in a daemon thread.
+
+    The driver registers message callbacks keyed by type; unknown types get
+    an ERR reply (reference `rpc.py:207-233,250-286`).
+    """
+
+    def __init__(self, num_executors: int, secret: Optional[str] = None):
+        self.num_executors = num_executors
+        self.secret_hex = secret or pysecrets.token_hex(16)
+        self.secret = self.secret_hex.encode()
+        self.reservations = Reservations(num_executors)
+        self._buffers: Dict[socket.socket, bytearray] = {}
+        self._sel = selectors.DefaultSelector()
+        self._listener: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+        self._handlers: Dict[str, Callable[[Dict[str, Any]], Dict[str, Any]]] = {}
+        self._register_handlers()
+
+    # subclasses override
+    def _register_handlers(self) -> None:
+        self._handlers["QUERY"] = lambda msg: {
+            "type": "QUERY",
+            "done": self.reservations.done(),
+        }
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(128)
+        srv.setblocking(False)
+        self._listener = srv
+        self._sel.register(srv, selectors.EVENT_READ, self._accept)
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="rpc-server")
+        self._thread.start()
+        return srv.getsockname()
+
+    def _accept(self, sock, mask):
+        conn, _ = sock.accept()
+        # Non-blocking with a per-connection reassembly buffer: a stalled or
+        # half-dead client must never freeze the event loop (runner crashes
+        # mid-send are exactly what this layer detects).
+        conn.setblocking(False)
+        self._buffers[conn] = bytearray()
+        self._sel.register(conn, selectors.EVENT_READ, self._serve)
+
+    def _serve(self, conn, mask):
+        try:
+            chunk = conn.recv(constants.RPC_RECV_BUFSIZE)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._drop(conn)
+            return
+        if not chunk:
+            self._drop(conn)
+            return
+        buf = self._buffers[conn]
+        buf.extend(chunk)
+        while True:
+            frame = self._try_extract_frame(conn, buf)
+            if frame is None:
+                return
+            self._dispatch(conn, frame)
+
+    def _try_extract_frame(self, conn, buf: bytearray):
+        """Pop one complete authenticated frame from the buffer, or None."""
+        header = 4 + 32
+        if len(buf) < header:
+            return None
+        (length,) = _LEN.unpack(bytes(buf[:4]))
+        if length > MAX_FRAME:
+            self._drop(conn)
+            return None
+        if len(buf) < header + length:
+            return None
+        mac, payload = bytes(buf[4:header]), bytes(buf[header:header + length])
+        del buf[: header + length]
+        if not hmac.compare_digest(mac, _sign(self.secret, payload)):
+            self._drop(conn)
+            return None
+        return payload
+
+    def _dispatch(self, conn, payload: bytes):
+        try:
+            msg = msgpack.unpackb(payload, raw=False, strict_map_key=False)
+            handler = self._handlers.get(msg.get("type"))
+            if handler is None:
+                resp = {"type": "ERR", "error": "unknown message type"}
+            else:
+                resp = handler(msg)
+        except (ConnectionError, socket.timeout, OSError):
+            self._drop(conn)
+            return
+        except Exception as e:  # noqa: BLE001 - a bad message must never kill the loop
+            resp = {"type": "ERR", "error": "handler error: {!r}".format(e)}
+        try:
+            conn.setblocking(True)
+            MessageSocket.send_msg(conn, resp, self.secret)
+        except OSError:
+            self._drop(conn)
+        finally:
+            try:
+                conn.setblocking(False)
+            except OSError:
+                pass
+
+    def _drop(self, conn):
+        self._buffers.pop(conn, None)
+        try:
+            self._sel.unregister(conn)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _loop(self):
+        while not self._stop_event.is_set():
+            events = self._sel.select(timeout=0.2)
+            for key, mask in events:
+                key.data(key.fileobj, mask)
+
+    def await_reservations(
+        self, timeout: float = constants.REGISTRATION_TIMEOUT_S,
+        on_timeout: Optional[Callable[[], None]] = None,
+    ) -> Dict[int, Dict[str, Any]]:
+        """Driver-side registration barrier (reference `rpc.py:182-205`)."""
+        deadline = time.monotonic() + timeout
+        while not self.reservations.done():
+            if time.monotonic() > deadline:
+                if on_timeout:
+                    on_timeout()
+                raise TimeoutError(
+                    "Registration barrier timed out: {} of {} executors missing.".format(
+                        self.reservations.remaining(), self.num_executors
+                    )
+                )
+            time.sleep(0.1)
+        return self.reservations.all()
+
+    def stop(self):
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for key in list(self._sel.get_map().values()):
+            self._drop(key.fileobj)
+        self._sel.close()
+
+
+class OptimizationServer(Server):
+    """HPO/ablation message semantics (reference `rpc.py:295-388`).
+
+    The driver attaches itself via `attach_driver` so handlers can read
+    trial state and enqueue worker messages.
+    """
+
+    def __init__(self, num_executors: int, secret: Optional[str] = None):
+        self.driver = None
+        super().__init__(num_executors, secret)
+
+    def attach_driver(self, driver) -> None:
+        self.driver = driver
+
+    def _register_handlers(self) -> None:
+        super()._register_handlers()
+        self._handlers.update(
+            REG=self._reg,
+            METRIC=self._metric,
+            FINAL=self._final,
+            GET=self._get,
+            LOG=self._log,
+        )
+
+    def _reg(self, msg):
+        # Failure detection (reference `rpc.py:308-326`): a re-registration
+        # from a partition already holding a trial means the executor died
+        # and was relaunched -> mark that trial ERROR, queue BLACK.
+        prev = self.reservations.get_assigned_trial(msg["partition_id"])
+        self.reservations.add(
+            {"partition_id": msg["partition_id"], "host_port": msg.get("host_port"),
+             "task_attempt": msg.get("task_attempt", 0), "trial_id": prev}
+        )
+        if prev is not None:
+            self.driver.enqueue({"type": "BLACK", "trial_id": prev,
+                                 "partition_id": msg["partition_id"]})
+        else:
+            # First registration: ask the driver worker for a first assignment.
+            self.driver.enqueue({"type": "REG", "partition_id": msg["partition_id"]})
+        return {"type": "OK"}
+
+    def _metric(self, msg):
+        self.driver.enqueue(dict(msg))
+        trial_id = msg.get("trial_id")
+        stop = False
+        if trial_id:
+            trial = self.driver.get_trial(trial_id)
+            stop = bool(trial and trial.get_early_stop())
+        return {"type": "STOP"} if stop else {"type": "OK"}
+
+    def _final(self, msg):
+        self.reservations.assign_trial(msg["partition_id"], None)
+        self.driver.enqueue(dict(msg))
+        return {"type": "OK"}
+
+    def _get(self, msg):
+        # Serve an already-assigned trial BEFORE honoring experiment-done:
+        # the last suggestion may be assigned concurrently with another
+        # FINAL ending the experiment, and must still run.
+        trial_id = self.reservations.get_assigned_trial(msg["partition_id"])
+        if trial_id is None:
+            if self.driver.experiment_done:
+                return {"type": "GSTOP"}
+            return {"type": "OK", "trial_id": None}
+        trial = self.driver.get_trial(trial_id)
+        if trial is None:
+            return {"type": "OK", "trial_id": None}
+        trial.set_status(Trial.RUNNING)
+        trial.start = time.time()
+        return {"type": "TRIAL", "trial_id": trial.trial_id, "params": trial.params}
+
+    def _log(self, msg):
+        return {"type": "LOG", **self.driver.progress_snapshot()}
+
+
+class DistributedServer(Server):
+    """Adds the coordinator rendezvous: DIST_CONFIG returns partition-0's
+    advertised host plus world size, replacing the reference's TORCH_CONFIG
+    MASTER_ADDR/PORT brokering (`rpc.py:391-437`). Runners pass it to
+    `jax.distributed.initialize`."""
+
+    def __init__(self, num_executors: int, secret: Optional[str] = None):
+        self.driver = None
+        super().__init__(num_executors, secret)
+
+    def attach_driver(self, driver) -> None:
+        self.driver = driver
+
+    def _register_handlers(self) -> None:
+        super()._register_handlers()
+        self._handlers.update(
+            REG=self._reg,
+            METRIC=self._metric,
+            FINAL=self._final,
+            DIST_CONFIG=self._dist_config,
+            LOG=self._log,
+        )
+
+    def _reg(self, msg):
+        self.reservations.add(
+            {"partition_id": msg["partition_id"], "host_port": msg.get("host_port"),
+             "task_attempt": msg.get("task_attempt", 0), "trial_id": None}
+        )
+        return {"type": "OK"}
+
+    def _metric(self, msg):
+        if self.driver is not None:
+            self.driver.enqueue(dict(msg))
+        return {"type": "OK"}
+
+    def _final(self, msg):
+        if self.driver is not None:
+            self.driver.enqueue(dict(msg))
+        return {"type": "OK"}
+
+    def _dist_config(self, msg):
+        rec = self.reservations.get(0)
+        if rec is None or not self.reservations.done():
+            return {"type": "OK", "config": None}
+        return {
+            "type": "DIST_CONFIG",
+            "config": {
+                "coordinator_address": rec["host_port"],
+                "num_processes": self.num_executors,
+            },
+        }
+
+    def _log(self, msg):
+        snap = self.driver.progress_snapshot() if self.driver else {}
+        return {"type": "LOG", **snap}
+
+
+# --------------------------------------------------------------------- client
+
+
+class Client:
+    """Executor-side control-plane client (reference `rpc.py:440-593`).
+
+    One request socket + one dedicated heartbeat socket; the heartbeat
+    daemon ships (metric, step, logs) every ``hb_interval`` and applies STOP
+    replies to the reporter.
+    """
+
+    def __init__(
+        self,
+        server_addr: Tuple[str, int],
+        partition_id: int,
+        task_attempt: int,
+        hb_interval: float,
+        secret: str,
+    ):
+        self.server_addr = tuple(server_addr)
+        self.partition_id = partition_id
+        self.task_attempt = task_attempt
+        self.hb_interval = hb_interval
+        self.secret = secret.encode() if isinstance(secret, str) else secret
+        self.done = False
+        self._sock = self._connect()
+        self._hb_sock = self._connect()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self._lock = threading.Lock()  # serializes the request socket
+
+    def _connect(self) -> socket.socket:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(30.0)
+        sock.connect(self.server_addr)
+        return sock
+
+    def _request(self, msg: Dict[str, Any], sock: Optional[socket.socket] = None,
+                 lock: bool = True) -> Dict[str, Any]:
+        """Send one message with reconnect retries (reference `rpc.py:465-493`)."""
+        target = sock or self._sock
+        msg = {**msg, "partition_id": self.partition_id,
+               "task_attempt": self.task_attempt}
+        last_err = None
+        for attempt in range(constants.CLIENT_MAX_RETRIES + 1):
+            try:
+                if lock and target is self._sock:
+                    with self._lock:
+                        MessageSocket.send_msg(target, msg, self.secret)
+                        return MessageSocket.recv_msg(target, self.secret)
+                MessageSocket.send_msg(target, msg, self.secret)
+                return MessageSocket.recv_msg(target, self.secret)
+            except (ConnectionError, socket.timeout, OSError) as e:
+                last_err = e
+                time.sleep(0.2 * (attempt + 1))
+                fresh = self._connect()
+                if target is self._sock:
+                    self._sock = fresh
+                elif target is self._hb_sock:
+                    self._hb_sock = fresh
+                target = fresh
+        raise ConnectionError("RPC request failed after retries: {}".format(last_err))
+
+    # ----------------------------------------------------------------- calls
+
+    def register(self, host_port: Optional[str] = None) -> None:
+        self._request({"type": "REG", "host_port": host_port})
+
+    def await_reservations(self, timeout: float = constants.REGISTRATION_TIMEOUT_S) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            resp = self._request({"type": "QUERY"})
+            if resp.get("done"):
+                return
+            time.sleep(constants.CLIENT_POLL_INTERVAL_S)
+        raise TimeoutError("Registration barrier not reached.")
+
+    def start_heartbeat(self, reporter) -> None:
+        def beat():
+            while not self._hb_stop.is_set():
+                try:
+                    data = reporter.get_data()
+                    resp = self._request(
+                        {"type": "METRIC", "trial_id": reporter.trial_id,
+                         "value": data["metric"], "step": data["step"],
+                         "logs": data["logs"]},
+                        sock=self._hb_sock, lock=False,
+                    )
+                    if resp.get("type") == "STOP":
+                        reporter.early_stop()
+                except ConnectionError:
+                    pass
+                self._hb_stop.wait(self.hb_interval)
+
+        self._hb_thread = threading.Thread(target=beat, daemon=True, name="heartbeat")
+        self._hb_thread.start()
+
+    def get_suggestion(self, timeout: Optional[float] = None):
+        """Blocking poll for the next trial; returns (trial_id, params) or
+        (None, None) when the experiment is over (reference `rpc.py:537-546`)."""
+        deadline = time.monotonic() + timeout if timeout else None
+        while True:
+            resp = self._request({"type": "GET"})
+            rtype = resp.get("type")
+            if rtype == "GSTOP":
+                self.done = True
+                return None, None
+            if rtype == "TRIAL":
+                return resp["trial_id"], resp["params"]
+            if deadline and time.monotonic() > deadline:
+                return None, None
+            time.sleep(constants.DRIVER_IDLE_REQUEUE_TICK_S)
+
+    def get_dist_config(self, timeout: float = constants.RENDEZVOUS_TIMEOUT_S):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            resp = self._request({"type": "DIST_CONFIG"})
+            if resp.get("config"):
+                return resp["config"]
+            time.sleep(0.5)
+        raise TimeoutError("Coordinator rendezvous timed out.")
+
+    def finalize_metric(self, metric, reporter) -> None:
+        """Send FINAL and reset the reporter atomically under its lock
+        (reference `rpc.py:584-593`)."""
+        with reporter.lock:
+            data = reporter.get_data()
+            self._request(
+                {"type": "FINAL", "trial_id": reporter.trial_id,
+                 "value": metric, "logs": data["logs"]}
+            )
+            reporter.reset()
+
+    def get_progress(self) -> Dict[str, Any]:
+        return self._request({"type": "LOG"})
+
+    def stop(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+        for sock in (self._sock, self._hb_sock):
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -1,0 +1,96 @@
+"""`lagom` — the single experiment entry point.
+
+Parity: reference `maggy/experiment.py` — one-experiment-at-a-time module
+guard (:42-45), `lagom(train_fn, config)` (:48-83), `@singledispatch` driver
+dispatch on config type (:86-108), exception handler marking the experiment
+FAILED (:111-128), atexit kill-handler (:131-148).
+
+"Lagom" (Swedish): just the right amount — keep every runner busy with
+asynchronous trials, never more resources than needed.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import time
+from functools import singledispatch
+from typing import Any, Callable
+
+from maggy_tpu import util
+from maggy_tpu.config import (
+    AblationConfig,
+    DistributedConfig,
+    LagomConfig,
+    OptimizationConfig,
+)
+from maggy_tpu.core.environment import EnvSing
+
+APP_ID: str | None = None
+RUNNING = False
+RUN_ID = 0
+
+
+def lagom(train_fn: Callable, config: LagomConfig) -> Any:
+    """Launch an experiment: asynchronous HPO, an ablation study, or
+    distributed training, selected by the config type."""
+    global APP_ID, RUNNING, RUN_ID
+    if RUNNING:
+        raise RuntimeError("An experiment is already running in this process.")
+    env = EnvSing.get_instance()
+    if APP_ID is None:
+        APP_ID = os.environ.get("MAGGY_TPU_APP_ID",
+                                "app-{}".format(time.strftime("%Y%m%d-%H%M%S")))
+    # Scan the SAME directory the driver will register under (a custom
+    # experiment_dir must not collide at run 0), via the env's own fs.
+    base = getattr(config, "experiment_dir", None) or env.experiment_base_dir()
+    RUN_ID = util.next_run_id(base, APP_ID, env=env)
+    RUNNING = True
+    driver = None
+    try:
+        driver = lagom_driver(config, APP_ID, RUN_ID)
+        atexit.register(_exit_handler, driver)
+        return driver.run_experiment(train_fn)
+    finally:
+        RUNNING = False
+        if driver is not None:
+            atexit.unregister(_exit_handler)
+
+
+@singledispatch
+def lagom_driver(config, app_id: str, run_id: int):
+    raise TypeError(
+        "Unsupported config type {}; use OptimizationConfig, AblationConfig, "
+        "or DistributedConfig.".format(type(config))
+    )
+
+
+@lagom_driver.register(OptimizationConfig)
+def _(config: OptimizationConfig, app_id: str, run_id: int):
+    from maggy_tpu.core.driver.optimization_driver import OptimizationDriver
+
+    return OptimizationDriver(config, app_id, run_id)
+
+
+@lagom_driver.register(AblationConfig)
+def _(config: AblationConfig, app_id: str, run_id: int):
+    from maggy_tpu.core.driver.ablation_driver import AblationDriver
+
+    return AblationDriver(config, app_id, run_id)
+
+
+@lagom_driver.register(DistributedConfig)
+def _(config: DistributedConfig, app_id: str, run_id: int):
+    from maggy_tpu.core.driver.distributed_driver import DistributedDriver
+
+    return DistributedDriver(config, app_id, run_id)
+
+
+def _exit_handler(driver) -> None:
+    """Mark the experiment KILLED if the process dies mid-run (reference
+    `experiment.py:131-148`)."""
+    try:
+        if not driver.experiment_done:
+            driver.env.finalize_experiment(driver.exp_dir, "KILLED", {})
+    except Exception:  # noqa: BLE001 - never raise at interpreter exit
+        pass

@@ -72,9 +72,10 @@ class AbstractOptimizer(ABC):
 
     def init_pruner(self):
         """Instantiate the pruner by name; only 'hyperband' exists (reference
-        `abstractoptimizer.py:297-315`)."""
-        if self._pruner_name is None:
-            return None
+        `abstractoptimizer.py:297-315`). Idempotent: the driver calls this
+        early to size the schedule, `_initialize` may call it again."""
+        if self.pruner is not None or self._pruner_name is None:
+            return self.pruner
         if isinstance(self._pruner_name, str):
             if self._pruner_name.lower() != "hyperband":
                 raise ValueError(

@@ -1,0 +1,5 @@
+from maggy_tpu.optimizers.bayes.base import BaseAsyncBO
+from maggy_tpu.optimizers.bayes.gp import GP
+from maggy_tpu.optimizers.bayes.tpe import TPE
+
+__all__ = ["BaseAsyncBO", "GP", "TPE"]

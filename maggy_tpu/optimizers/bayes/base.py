@@ -1,0 +1,235 @@
+"""Asynchronous Bayesian optimization skeleton.
+
+Parity: reference `maggy/optimizer/bayes/base.py` — warmup buffer (:358-373),
+ε-random exploration with random_fraction=0.33 (:239-245), per-budget
+surrogate `models` dict with key 0 = single-fidelity (:135-139), pruner
+delegation identical to RandomSearch (:187-226), duplicate rejection ending
+the experiment after 4 forced-random collisions (:285-298), finished check
+(:375-395), async-diversity machinery: busy locations with imputed metrics
+for in-flight trials (:397-454), `get_XY` training-matrix builder with
+optional interim results where configs are augmented with a normalized
+fidelity coordinate z=[x, n] (:456-638), busy-location gating (:667-677).
+
+Subclasses implement ``update_model(budget)`` and
+``sampling_routine(budget) -> params_dict``.
+"""
+
+from __future__ import annotations
+
+from abc import abstractmethod
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
+from maggy_tpu.trial import Trial
+
+
+class BaseAsyncBO(AbstractOptimizer):
+    def __init__(
+        self,
+        num_warmup_trials: int = 15,
+        random_fraction: float = 0.33,
+        interim_results: bool = False,
+        interim_results_interval: int = 10,
+        seed=None,
+        pruner=None,
+        pruner_kwargs=None,
+    ):
+        super().__init__(seed=seed, pruner=pruner, pruner_kwargs=pruner_kwargs)
+        self.num_warmup_trials = num_warmup_trials
+        self.random_fraction = random_fraction
+        self.interim_results = interim_results
+        self.interim_results_interval = interim_results_interval
+        self.warmup_buffer: List[dict] = []
+        #: budget -> fitted surrogate (0 = single fidelity), set by update_model
+        self.models: Dict[float, object] = {}
+        #: trial_id -> imputed metric for busy locations (diagnostics)
+        self.imputed_metrics: Dict[str, float] = {}
+        self._forced_random_failures = 0
+
+    # ------------------------------------------------------------- contract
+
+    @abstractmethod
+    def update_model(self, budget: float = 0) -> None:
+        """(Re)fit the surrogate for ``budget`` from current observations."""
+
+    @abstractmethod
+    def sampling_routine(self, budget: float = 0) -> dict:
+        """Propose the next hyperparameter dict by optimizing the surrogate."""
+
+    # ----------------------------------------------------------- main logic
+
+    def initialize(self) -> None:
+        n = min(self.num_warmup_trials, self.num_trials) if self.pruner is None \
+            else self.num_warmup_trials
+        self.warmup_buffer = self.searchspace.get_random_parameter_values(n, rng=self.rng)
+
+    def get_suggestion(self, trial: Optional[Trial] = None):
+        if self._experiment_finished():
+            return None
+
+        budget = 0
+        parent_id = None
+        if self.pruner is None:
+            # Count in-flight trials against the budget, else N concurrent
+            # runners overshoot num_trials by up to N-1.
+            if len(self.final_store) + len(self.trial_store) >= self.num_trials:
+                return "IDLE" if self.trial_store else None
+        if self.pruner is not None:
+            next_run = self.pruner.pruning_routine()
+            if next_run == "IDLE":
+                return "IDLE"
+            if next_run is None:
+                return None
+            parent_id, budget = next_run["trial_id"], next_run["budget"]
+            if parent_id is not None:
+                # Promotion: re-run parent's config at the new budget.
+                params = self._strip_budget(self._lookup_params(parent_id))
+                new_trial = self.create_trial(params, sample_type="promoted", run_budget=budget)
+                self.pruner.report_trial(parent_id, new_trial.trial_id)
+                return new_trial
+
+        new_trial = self._propose(budget)
+        if new_trial is None:
+            return None
+        if self.pruner is not None:
+            self.pruner.report_trial(None, new_trial.trial_id)
+        return new_trial
+
+    def _propose(self, budget: float) -> Optional[Trial]:
+        # 1. warmup buffer
+        if self.warmup_buffer:
+            params = self.warmup_buffer.pop(0)
+            return self.create_trial(params, sample_type="random", run_budget=budget)
+        # 2. ε-random exploration / not enough data for a model
+        model_budget = self._model_budget(budget)
+        have_data = len(self._finalized(model_budget if model_budget else None)) >= max(
+            3, len(self.searchspace) + 1
+        )
+        trial = None
+        if self.rng.random() >= self.random_fraction and have_data:
+            self.update_model(model_budget)
+            if self.models.get(model_budget) is not None:
+                params = self.sampling_routine(model_budget)
+                trial = self.create_trial(
+                    params, sample_type="model", run_budget=budget, model_budget=model_budget
+                )
+        if trial is None:
+            params = self.searchspace.get_random_parameter_values(1, rng=self.rng)[0]
+            trial = self.create_trial(params, sample_type="random", run_budget=budget)
+        # 3. duplicate rejection: up to 4 forced-random retries (reference
+        #    `base.py:285-298`).
+        retries = 0
+        while self.hparams_exist(trial) and retries < 4:
+            retries += 1
+            params = self.searchspace.get_random_parameter_values(1, rng=self.rng)[0]
+            trial = self.create_trial(params, sample_type="random_forced", run_budget=budget)
+        if self.hparams_exist(trial):
+            self._forced_random_failures += 1
+            return None
+        return trial
+
+    def _model_budget(self, run_budget: float) -> float:
+        """Which surrogate to use for a given run budget: largest budget with
+        enough observations, else the run budget itself (reference trains one
+        model per budget, falling back down the ladder)."""
+        if self.pruner is None:
+            return 0
+        candidates = sorted(
+            {t.params.get("budget", 0) for t in self.final_store}, reverse=True
+        )
+        for b in candidates:
+            if len(self._finalized(b)) >= max(3, len(self.searchspace) + 1):
+                return b
+        return run_budget
+
+    def _experiment_finished(self) -> bool:
+        if self.pruner is not None:
+            return self.pruner.finished()
+        return len(self.final_store) >= self.num_trials
+
+    def _lookup_params(self, trial_id: str) -> dict:
+        for t in self.final_store:
+            if t.trial_id == trial_id:
+                return dict(t.params)
+        if trial_id in self.trial_store:
+            return dict(self.trial_store[trial_id].params)
+        raise KeyError("Unknown trial id {}".format(trial_id))
+
+    # ------------------------------------------------- training-matrix build
+
+    def busy_locations(self, budget: float = 0) -> List[tuple]:
+        """(trial_id, config) of in-flight trials at this budget."""
+        out = []
+        for t in self.trial_store.values():
+            if budget in (0, t.params.get("budget", 0)):
+                out.append((t.trial_id, self._strip_budget(t.params)))
+        return out
+
+    def get_XY(
+        self,
+        budget: float = 0,
+        include_busy_locations: bool = False,
+        impute_strategy: str = "cl_min",
+        interim: bool = False,
+    ):
+        """Build (X, y) for surrogate training (reference `base.py:456-638`).
+
+        - metrics are direction-normalized (lower better)
+        - ``include_busy_locations``: append in-flight configs with an imputed
+          metric — constant liar cl_min/cl_max/cl_mean, or 'kb' (kriging
+          believer: posterior mean of the current model)
+        - ``interim``: one row per interim observation, config augmented with
+          a normalized fidelity coordinate n ∈ (0, 1]
+        """
+        trials = self._finalized(budget if budget else None)
+        sign = self._sign()
+        if not interim:
+            X = self.searchspace.transform_batch(
+                [self._strip_budget(t.params) for t in trials]
+            )
+            y = np.asarray([sign * t.final_metric for t in trials], dtype=np.float64)
+        else:
+            rows, ys = [], []
+            for t in trials:
+                hist = t.metric_history
+                if not hist:
+                    continue
+                x = self.searchspace.transform(self._strip_budget(t.params))
+                steps = list(range(0, len(hist), self.interim_results_interval))
+                if (len(hist) - 1) not in steps:
+                    steps.append(len(hist) - 1)
+                for s in steps:
+                    rows.append(np.concatenate([x, [(s + 1) / len(hist)]]))
+                    ys.append(sign * hist[s])
+            X = np.asarray(rows) if rows else np.zeros((0, len(self.searchspace) + 1))
+            y = np.asarray(ys, dtype=np.float64)
+
+        if include_busy_locations and not interim:
+            busy = self.busy_locations(budget)
+            if busy:
+                busy_ids = [tid for tid, _ in busy]
+                Xb = self.searchspace.transform_batch([cfg for _, cfg in busy])
+                yb = self._impute(Xb, y, impute_strategy, budget)
+                for tid, m in zip(busy_ids, yb):
+                    self.imputed_metrics[tid] = float(m)
+                X = np.vstack([X, Xb]) if X.size else Xb
+                y = np.concatenate([y, yb])
+        return X, y
+
+    def _impute(self, Xb: np.ndarray, y_obs: np.ndarray, strategy: str, budget: float):
+        if y_obs.size == 0:
+            return np.zeros(len(Xb))
+        if strategy == "cl_min":
+            return np.full(len(Xb), float(np.min(y_obs)))
+        if strategy == "cl_max":
+            return np.full(len(Xb), float(np.max(y_obs)))
+        if strategy == "cl_mean":
+            return np.full(len(Xb), float(np.mean(y_obs)))
+        if strategy == "kb":
+            model = self.models.get(budget)
+            if model is None:
+                return np.full(len(Xb), float(np.mean(y_obs)))
+            return np.asarray(model.predict(Xb)).reshape(-1)
+        raise ValueError("Unknown impute strategy {!r}".format(strategy))

@@ -1,0 +1,4 @@
+from maggy_tpu.parallel.mesh import ShardingEnv, make_mesh
+from maggy_tpu.parallel.sharding import shard_params, batch_sharding, param_sharding
+
+__all__ = ["ShardingEnv", "make_mesh", "shard_params", "batch_sharding", "param_sharding"]

@@ -1,0 +1,102 @@
+"""Device meshes and the ShardingEnv handed to distributed train functions.
+
+This is the ICI data plane the reference delegates to NCCL/DDP
+(`dist_executor.py:89-102,197-223`): instead of wrapping a model in DDP, the
+user's train function receives a `ShardingEnv` — a named `jax.sharding.Mesh`
+plus helpers — and writes a jit-compiled step; GSPMD inserts the gradient
+all-reduces over ICI.
+
+Mesh axis conventions (scaling-book style):
+- "data":   data parallelism (batch axis; gradients all-reduced)
+- "fsdp":   fully-sharded data parallelism (params sharded over data axis)
+- "model":  tensor parallelism (weights sharded within layers)
+- "seq":    sequence/context parallelism (ring attention)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def make_mesh(mesh_shape: Dict[str, int], devices: Optional[Sequence] = None):
+    """Build a named Mesh from {"axis": size}. Sizes must multiply to the
+    device count; a single -1 axis is inferred."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    shape = dict(mesh_shape) if mesh_shape else {"data": len(devices)}
+    sizes = list(shape.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("At most one mesh axis may be -1.")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if len(devices) % known:
+            raise ValueError(
+                "Device count {} not divisible by fixed axes {}".format(len(devices), known)
+            )
+        sizes[sizes.index(-1)] = len(devices) // known
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(
+            "Mesh {} needs {} devices, have {}.".format(shape, int(np.prod(sizes)), len(devices))
+        )
+    arr = np.asarray(devices).reshape(sizes)
+    return jax.sharding.Mesh(arr, tuple(shape.keys()))
+
+
+@dataclass
+class ShardingEnv:
+    """What a distributed train function gets instead of a DDP model wrapper.
+
+    ``process_index``/``process_count`` mirror the reference's RANK/WORLD_SIZE
+    (`dist_executor.py:89-100`); ``shard_count``/``current_shard`` express the
+    per-rank input sharding contract of `patching.py:70-79`.
+    """
+
+    mesh: Any
+    process_index: int = 0
+    process_count: int = 1
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    @property
+    def current_shard(self) -> int:
+        return self.process_index
+
+    @property
+    def shard_count(self) -> int:
+        return self.process_count
+
+    def data_sharding(self, *rest_axes: Optional[str]):
+        """NamedSharding for a batch: leading dim over every data-like mesh
+        axis, remaining dims as given (None = replicated)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data_axes = tuple(a for a in ("data", "fsdp") if a in self.axis_names)
+        spec = P(data_axes if data_axes else None, *rest_axes)
+        return NamedSharding(self.mesh, spec)
+
+    def replicated(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P())
+
+    def shard_batch(self, batch):
+        """Place a host batch onto the mesh, sharded on the leading axis."""
+        import jax
+
+        def place(x):
+            sh = self.data_sharding(*([None] * (x.ndim - 1)))
+            return jax.device_put(x, sh)
+
+        return jax.tree_util.tree_map(place, batch)

@@ -1,0 +1,90 @@
+"""Parameter/batch sharding rules: dp / fsdp / tp in one place.
+
+The reference's only parallelism is DDP data parallelism
+(`dist_executor.py:102`, SURVEY.md §2.8). TPU-native, the same and more fall
+out of GSPMD sharding specs:
+
+- dp:    params replicated, batch sharded on "data" -> XLA all-reduces grads
+- fsdp:  params sharded on their largest divisible axis over "fsdp"
+         (ZeRO-3-style; all-gather on use, reduce-scatter on grads)
+- tp:    matmul weights sharded on "model" (Megatron-style column/row)
+
+`shard_params` computes a NamedSharding pytree for a params pytree by simple,
+robust rules (largest-divisible-axis) rather than per-model annotations; the
+model zoo can override with explicit rules where it matters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _best_axis(shape, axis_size: int, prefer_last: bool = True) -> Optional[int]:
+    """Largest dimension divisible by axis_size (ties -> last/first)."""
+    candidates = [(d, i) for i, d in enumerate(shape) if d % axis_size == 0 and d >= axis_size]
+    if not candidates:
+        return None
+    best_d = max(d for d, _ in candidates)
+    idxs = [i for d, i in candidates if d == best_d]
+    return idxs[-1] if prefer_last else idxs[0]
+
+
+def param_sharding(mesh, path_shape_leaf, strategy: str = "dp"):
+    """NamedSharding for ONE param leaf under the given strategy."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shape = np.shape(path_shape_leaf) if not hasattr(path_shape_leaf, "shape") \
+        else path_shape_leaf.shape
+    names = mesh.axis_names
+    if strategy == "dp" or not shape:
+        return NamedSharding(mesh, P())
+    if strategy == "fsdp" and "fsdp" in names:
+        ax = _best_axis(shape, mesh.shape["fsdp"])
+        if ax is None:
+            return NamedSharding(mesh, P())
+        spec = [None] * len(shape)
+        spec[ax] = "fsdp"
+        return NamedSharding(mesh, P(*spec))
+    if strategy == "tp" and "model" in names:
+        ax = _best_axis(shape, mesh.shape["model"])
+        if ax is None:
+            return NamedSharding(mesh, P())
+        spec = [None] * len(shape)
+        spec[ax] = "model"
+        return NamedSharding(mesh, P(*spec))
+    if strategy in ("fsdp_tp", "dp_tp"):
+        # model axis on the last divisible dim, fsdp on another if present
+        spec = [None] * len(shape)
+        if "model" in names:
+            ax = _best_axis(shape, mesh.shape["model"])
+            if ax is not None:
+                spec[ax] = "model"
+        if strategy == "fsdp_tp" and "fsdp" in names:
+            free = [i for i, s in enumerate(spec) if s is None]
+            cands = [i for i in free if shape[i] % mesh.shape["fsdp"] == 0]
+            if cands:
+                spec[max(cands, key=lambda i: shape[i])] = "fsdp"
+        return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P())
+
+
+def shard_params(mesh, params, strategy: str = "dp"):
+    """Sharding pytree for a whole params pytree."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda leaf: param_sharding(mesh, leaf, strategy), params
+    )
+
+
+def batch_sharding(mesh, ndim: int = 2):
+    """Batch sharded over every data-like axis on dim 0, replicated after."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    data_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    return NamedSharding(mesh, P(data_axes if data_axes else None,
+                                 *([None] * (ndim - 1))))

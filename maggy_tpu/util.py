@@ -1,0 +1,112 @@
+"""Framework utilities.
+
+Parity: reference `maggy/util.py` — return-value validation + persistence
+`handle_return_val` (:151-191), experiment registration (:264-279), numpy-safe
+json (:89-99), progress bar (:71-86), summary builder (:126-148).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from maggy_tpu import constants
+from maggy_tpu.exceptions import MetricTypeError, ReturnTypeError
+
+
+def json_default_numpy(obj):
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    raise TypeError("Type {} not serializable".format(type(obj)))
+
+
+def json_dumps_safe(obj: Any) -> str:
+    return json.dumps(obj, default=json_default_numpy)
+
+
+def handle_return_val(return_val: Any, trial_dir: str, optimization_key: str,
+                      env=None) -> float:
+    """Validate the user function's return value and persist artifacts.
+
+    Accepts a number (the metric) or a dict containing ``optimization_key``;
+    writes ``.outputs.json`` + ``.metric`` into the trial dir (reference
+    `util.py:151-191`).
+    """
+    from maggy_tpu.core.environment import EnvSing
+
+    env = env or EnvSing.get_instance()
+    if isinstance(return_val, dict):
+        if optimization_key not in return_val:
+            raise ReturnTypeError(optimization_key, return_val)
+        metric = return_val[optimization_key]
+        outputs = return_val
+    elif isinstance(return_val, constants.USER_FCT.NUMERIC_TYPES) and not isinstance(return_val, bool):
+        metric = return_val
+        outputs = {optimization_key: return_val}
+    else:
+        raise ReturnTypeError(optimization_key, return_val)
+    if not isinstance(metric, constants.USER_FCT.NUMERIC_TYPES) or isinstance(metric, bool):
+        raise MetricTypeError(optimization_key, metric)
+    metric = float(metric)
+    env.dump(json.dumps(outputs, default=json_default_numpy), trial_dir + "/.outputs.json")
+    env.dump(str(metric), trial_dir + "/.metric")
+    return metric
+
+
+def write_hparams_config(exp_dir: str, searchspace, env=None) -> None:
+    """Persist the searchspace for TensorBoard-HParams-style tooling
+    (reference `tensorboard.py:75-87`)."""
+    from maggy_tpu.core.environment import EnvSing
+
+    if searchspace is None:
+        return
+    env = env or EnvSing.get_instance()
+    env.dump(json.dumps(searchspace.to_dict(), indent=2), exp_dir + "/searchspace.json")
+
+
+def build_summary(exp_dir: str, env=None) -> Dict[str, Any]:
+    """Aggregate every trial dir's .hparams.json/.outputs.json into one
+    summary (reference `util.py:126-148`)."""
+    from maggy_tpu.core.environment import EnvSing
+
+    env = env or EnvSing.get_instance()
+    combos = []
+    for entry in env.ls(exp_dir):
+        tdir = os.path.join(exp_dir, entry)
+        hparams_p, outputs_p = tdir + "/.hparams.json", tdir + "/.outputs.json"
+        if env.isdir(tdir) and env.exists(outputs_p):
+            combo = {"id": entry}
+            if env.exists(hparams_p):
+                combo["hparams"] = json.loads(env.load(hparams_p))
+            combo["outputs"] = json.loads(env.load(outputs_p))
+            combos.append(combo)
+    summary = {"combinations": combos, "built_at": time.time()}
+    env.dump(json.dumps(summary, indent=2, default=json_default_numpy),
+             exp_dir + "/.summary.json")
+    return summary
+
+
+def progress_bar(done: int, total: int, width: int = 30) -> str:
+    frac = 0 if total == 0 else done / total
+    filled = int(width * frac)
+    return "[{}{}] {}/{}".format("=" * filled, " " * (width - filled), done, total)
+
+
+def next_run_id(base_dir: str, app_id: str, env=None) -> int:
+    """Monotonic run id per app id under the experiment base dir, checked
+    through the environment's filesystem (works for gs:// paths too)."""
+    from maggy_tpu.core.environment import EnvSing
+
+    env = env or EnvSing.get_instance()
+    i = 0
+    while env.exists("{}/{}_{}".format(base_dir.rstrip("/"), app_id, i)):
+        i += 1
+    return i

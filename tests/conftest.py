@@ -7,10 +7,18 @@ documented JAX approach for testing pjit/shard_map without accelerators).
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# XLA_FLAGS is read lazily at CPU-client creation, so setting it here works
+# even though the environment's sitecustomize imports jax at startup.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# jax may ALREADY be imported (sitecustomize registers the TPU plugin before
+# conftest runs), so env vars alone are too late — override the live config.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

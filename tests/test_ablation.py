@@ -1,0 +1,127 @@
+"""Ablation subsystem tests: study spec, LOCO schedule, end-to-end lagom."""
+
+import pytest
+
+from maggy_tpu import AblationConfig, experiment
+from maggy_tpu.ablation import AblationStudy
+from maggy_tpu.ablation.ablator import LOCO
+from maggy_tpu.core.environment import EnvSing
+from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+
+
+@pytest.fixture(autouse=True)
+def local_env(tmp_path):
+    env = LocalEnv(base_dir=str(tmp_path / "exp"))
+    EnvSing.set_instance(env)
+    yield env
+    EnvSing.reset()
+
+
+def make_study():
+    study = AblationStudy("toy", 1, "label",
+                          dataset_generator=toy_dataset_generator)
+    study.features.include("age", "fare")
+    study.model.set_base_model_generator(toy_model_generator)
+    study.model.layers.include("dense_1")
+    study.model.layers.include_groups(["dense_2", "dense_3"])
+    study.model.layers.include_groups(prefix="conv")
+    return study
+
+
+# Module-level generators: declarative specs resolve to these by reference.
+FEATURES = ["age", "fare", "sex"]
+
+
+def toy_dataset_generator(ablated_feature=None):
+    cols = [f for f in FEATURES if f != ablated_feature]
+    return {"columns": cols}
+
+
+def toy_model_generator(ablated_layers=frozenset()):
+    layers = ["conv_a", "conv_b", "dense_1", "dense_2", "dense_3"]
+    if any(l.startswith(p) for p in ablated_layers for l in layers):
+        # prefix groups arrive as 1-element frozensets
+        layers = [l for l in layers
+                  if not any(l.startswith(p) for p in ablated_layers)]
+    return {"layers": layers}
+
+
+class TestStudySpec:
+    def test_feature_include_exclude(self):
+        study = make_study()
+        assert study.features.list_all() == ["age", "fare"]
+        study.features.exclude("age")
+        assert study.features.list_all() == ["fare"]
+
+    def test_group_validation(self):
+        study = AblationStudy()
+        with pytest.raises(ValueError, match=">= 2"):
+            study.model.layers.include_groups(["single"])
+
+    def test_to_dict(self):
+        d = make_study().to_dict()
+        assert d["included_features"] == ["age", "fare"]
+        assert ["conv"] in d["included_layers"]  # prefix group
+        assert ["dense_2", "dense_3"] in d["included_layers"]
+
+
+class TestLocoSchedule:
+    def test_trial_count(self):
+        loco = LOCO(make_study())
+        # 1 base + 2 features + 1 layer + 2 groups (explicit + prefix)
+        assert loco.get_number_of_trials() == 6
+        loco.initialize()
+        assert len(loco.trial_buffer) == 6
+
+    def test_trials_declarative_and_unique(self):
+        loco = LOCO(make_study())
+        loco.initialize()
+        trials = [loco.get_trial() for _ in range(6)]
+        assert loco.get_trial() is None
+        ids = {t.trial_id for t in trials}
+        assert len(ids) == 6
+        for t in trials:
+            # Params are msgpack-serializable scalars/lists, never callables.
+            for v in t.params.values():
+                assert isinstance(v, (str, int, float, list))
+
+    def test_resolver(self):
+        loco = LOCO(make_study())
+        loco.initialize()
+        resolver = loco.make_resolver()
+        feature_trial = [t for t in [loco.get_trial() for _ in range(6)]
+                         if t.params["ablated_feature"] == "age"][0]
+        resolved = resolver(dict(feature_trial.params))
+        assert resolved["ablated_feature"] == "age"
+        assert resolved["dataset_function"]()["columns"] == ["fare", "sex"]
+        assert "dense_1" in resolved["model_function"]()["layers"]
+
+
+def ablation_train_fn(dataset_function, model_function, ablated_feature,
+                      ablated_layer, reporter=None):
+    data = dataset_function()
+    model = model_function()
+    # "accuracy" grows with features and layers kept.
+    return 0.1 * len(data["columns"]) + 0.05 * len(model["layers"])
+
+
+class TestAblationE2E:
+    def test_full_study(self, local_env):
+        config = AblationConfig(
+            name="loco_e2e", ablation_study=make_study(), ablator="loco",
+            direction="max", num_workers=2, hb_interval=0.05,
+        )
+        result = experiment.lagom(ablation_train_fn, config)
+        assert result["num_trials"] == 6
+        # The base trial (nothing ablated) must win under this objective.
+        assert result["best_hp"]["ablated_feature"] == "None"
+        assert result["best_hp"]["ablated_layer"] == "None"
+        # Prefix-group trial drops both conv layers -> worst of the layer trials.
+        assert result["best_val"] == pytest.approx(0.1 * 3 + 0.05 * 5)
+
+    def test_unknown_ablator(self):
+        with pytest.raises(ValueError, match="Unknown ablator"):
+            from maggy_tpu.core.driver.ablation_driver import AblationDriver
+
+            AblationDriver(AblationConfig(ablation_study=make_study(),
+                                          ablator="nope"), "a", 0)

@@ -1,0 +1,208 @@
+"""Bayesian-optimization unit tests: GP, TPE, acquisitions, async machinery.
+
+The reference ships zero BO tests (SURVEY.md §4); these verify with seeded
+RNG that (1) the full async loop runs, (2) surrogates actually steer sampling
+toward the optimum on a smooth function, (3) busy-location imputation and
+duplicate rejection behave as specified.
+"""
+
+import numpy as np
+import pytest
+
+from maggy_tpu.optimizers.bayes import GP, TPE
+from maggy_tpu.optimizers.bayes.acquisitions import (
+    GaussianProcess_EI,
+    GaussianProcess_LCB,
+    GaussianProcess_PI,
+)
+from maggy_tpu.optimizers.bayes.kde import MixedKDE
+from maggy_tpu.searchspace import Searchspace
+from maggy_tpu.trial import Trial
+
+
+def wire(opt, sp, num_trials, direction="min"):
+    opt.searchspace = sp
+    opt.num_trials = num_trials
+    opt.trial_store = {}
+    opt.final_store = []
+    opt.direction = direction
+    opt._initialize()
+    return opt
+
+
+def drive(opt, objective, num_trials):
+    """Run the optimizer loop synchronously; returns finalized trials."""
+    finished = []
+    last = None
+    guard = 0
+    while len(finished) < num_trials and guard < num_trials * 8:
+        guard += 1
+        t = opt.get_suggestion(last)
+        if t is None:
+            break
+        if t == "IDLE":
+            continue
+        opt.trial_store[t.trial_id] = t
+        t.final_metric = objective(t.params)
+        t.status = Trial.FINALIZED
+        opt.trial_store.pop(t.trial_id)
+        opt.final_store.append(t)
+        finished.append(t)
+        last = t
+    return finished
+
+
+def quadratic(params):
+    # minimum at x=0.3, y=0.7
+    return (params["x"] - 0.3) ** 2 + (params["y"] - 0.7) ** 2
+
+
+def space2d():
+    return Searchspace(x=("DOUBLE", [0.0, 1.0]), y=("DOUBLE", [0.0, 1.0]))
+
+
+class TestGP:
+    def test_full_loop_beats_warmup(self):
+        opt = wire(GP(seed=0, num_warmup_trials=8, random_fraction=0.1), space2d(), 40)
+        finished = drive(opt, quadratic, 40)
+        assert len(finished) == 40
+        model_trials = [t for t in finished if t.info_dict["sample_type"] == "model"]
+        assert len(model_trials) >= 5  # the surrogate was actually used
+        warmup_best = min(quadratic(t.params) for t in finished[:8])
+        overall_best = min(quadratic(t.params) for t in finished)
+        assert overall_best <= warmup_best  # BO did not get worse
+        assert overall_best < 0.01  # and actually honed in
+
+    def test_busy_location_imputation(self):
+        opt = wire(GP(seed=1, num_warmup_trials=4), space2d(), 20)
+        finished = drive(opt, quadratic, 10)
+        # Leave one trial in flight and refit: imputed metric recorded.
+        t = opt.get_suggestion(finished[-1])
+        assert isinstance(t, Trial)
+        opt.trial_store[t.trial_id] = t
+        opt.update_model(0)
+        assert t.trial_id in opt.imputed_metrics
+        # cl_min: liar equals best observed normalized metric
+        y = np.asarray([tr.final_metric for tr in opt.final_store])
+        assert np.isclose(opt.imputed_metrics[t.trial_id], y.min())
+
+    def test_asy_ts_strategy(self):
+        opt = wire(GP(seed=2, async_strategy="asy_ts", num_warmup_trials=6,
+                      random_fraction=0.1), space2d(), 20)
+        finished = drive(opt, quadratic, 20)
+        assert len(finished) == 20
+        assert any(t.info_dict["sample_type"] == "model" for t in finished)
+
+    def test_direction_max(self):
+        opt = wire(GP(seed=3, num_warmup_trials=8, random_fraction=0.1),
+                   space2d(), 30, direction="max")
+        finished = drive(opt, lambda p: -quadratic(p), 30)
+        best = max(-quadratic(t.params) for t in finished)
+        assert best > -0.01
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError, match="async_strategy"):
+            GP(async_strategy="bogus")
+        with pytest.raises(ValueError, match="impute_strategy"):
+            GP(impute_strategy="bogus")
+        with pytest.raises(ValueError, match="acquisition"):
+            GP(acquisition="bogus")
+
+
+class TestTPE:
+    def test_full_loop_converges(self):
+        opt = wire(TPE(seed=0, num_warmup_trials=10, random_fraction=0.1), space2d(), 50)
+        finished = drive(opt, quadratic, 50)
+        assert len(finished) == 50
+        assert any(t.info_dict["sample_type"] == "model" for t in finished)
+        assert min(quadratic(t.params) for t in finished) < 0.02
+
+    def test_mixed_space(self):
+        sp = Searchspace(x=("DOUBLE", [0.0, 1.0]), act=("CATEGORICAL", ["a", "b", "c"]))
+
+        def obj(p):  # "b" is best
+            return (p["x"] - 0.5) ** 2 + {"a": 1.0, "b": 0.0, "c": 2.0}[p["act"]]
+
+        opt = wire(TPE(seed=1, num_warmup_trials=10, random_fraction=0.1), sp, 60)
+        finished = drive(opt, obj, 60)
+        model_trials = [t for t in finished if t.info_dict["sample_type"] == "model"]
+        assert model_trials
+        # The model should mostly propose the good category.
+        frac_b = np.mean([t.params["act"] == "b" for t in model_trials[5:]])
+        assert frac_b > 0.5
+
+    def test_rejects_interim(self):
+        with pytest.raises(ValueError, match="interim"):
+            TPE(interim_results=True)
+
+
+class TestAcquisitions:
+    def make_model(self):
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import Matern, WhiteKernel
+
+        # Sparse observations of a quadratic, leaving the basin unobserved.
+        X = np.asarray([[0.0], [0.25], [0.75], [1.0]])
+        y = (X[:, 0] - 0.5) ** 2
+        gp = GaussianProcessRegressor(
+            kernel=Matern(length_scale=0.3, nu=2.5) + WhiteKernel(1e-6, (1e-9, 1e-2)),
+            normalize_y=True,
+            optimizer=None,  # pin hyperparameters: deterministic surrogate
+            random_state=0,
+        ).fit(X, y)
+        return gp, float(y.min())
+
+    def test_ei_prefers_unobserved_basin(self):
+        gp, y_opt = self.make_model()
+        # 0.5 (predicted low, uncertain) must beat 0.875 (predicted high).
+        vals = GaussianProcess_EI().evaluate(np.asarray([[0.5], [0.875]]), gp, y_opt)
+        assert vals[0] < vals[1]  # more negative EI in the basin
+
+    def test_pi_and_lcb_finite(self):
+        gp, y_opt = self.make_model()
+        X = np.random.default_rng(0).uniform(size=(10, 1))
+        assert np.all(np.isfinite(GaussianProcess_PI().evaluate(X, gp, y_opt)))
+        assert np.all(np.isfinite(GaussianProcess_LCB().evaluate(X, gp, y_opt)))
+
+
+class TestKDE:
+    def test_pdf_integrates_roughly(self):
+        rng = np.random.default_rng(0)
+        data = rng.normal(0.5, 0.1, size=(200, 1))
+        kde = MixedKDE(data, ["c"])
+        xs = np.linspace(-0.5, 1.5, 400)[:, None]
+        mass = np.trapezoid(kde.pdf(xs), xs[:, 0])
+        assert abs(mass - 1.0) < 0.05
+
+    def test_categorical_kernel_peaks_on_mode(self):
+        data = np.asarray([[0.0]] * 8 + [[1.0]] * 2)
+        kde = MixedKDE(data, ["u"], n_categories=[3])
+        p = kde.pdf(np.asarray([[0.0], [1.0], [2.0]]))
+        assert p[0] > p[1] > p[2]
+
+    def test_sample_around_in_bounds(self):
+        rng = np.random.default_rng(1)
+        data = rng.uniform(size=(20, 2))
+        kde = MixedKDE(data, ["c", "c"])
+        for _ in range(50):
+            x = kde.sample_around(rng, int(rng.integers(0, 20)))
+            assert np.all((x >= 0) & (x <= 1))
+
+
+class TestDuplicateRejection:
+    def test_forced_random_eventually_none(self):
+        # Tiny discrete-ish space where collisions are certain: INTEGER [0,1].
+        sp = Searchspace(n=("INTEGER", [0, 1]))
+        opt = wire(GP(seed=0, num_warmup_trials=0, random_fraction=1.0), sp, 10)
+        seen = []
+        for _ in range(10):
+            t = opt.get_suggestion()
+            if t is None:
+                break
+            opt.trial_store[t.trial_id] = t
+            t.final_metric = 0.0
+            opt.trial_store.pop(t.trial_id)
+            opt.final_store.append(t)
+            seen.append(t)
+        # Only 2 distinct configs exist; loop must terminate well before 10.
+        assert len(seen) <= 2

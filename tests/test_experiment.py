@@ -1,0 +1,176 @@
+"""End-to-end experiment tests: lagom over the thread runner pool.
+
+This is SURVEY.md §7.2 milestone 3 made a test: the full stack (driver +
+RPC + executors + optimizer + early stopping + artifacts) on one host, with
+a fast closed-form train function standing in for MNIST.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from maggy_tpu import OptimizationConfig, Searchspace
+from maggy_tpu import experiment
+from maggy_tpu.core.environment import EnvSing
+from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+
+
+@pytest.fixture(autouse=True)
+def local_env(tmp_path):
+    env = LocalEnv(base_dir=str(tmp_path / "exp"))
+    EnvSing.set_instance(env)
+    yield env
+    EnvSing.reset()
+
+
+def train_quadratic(lr, units, reporter=None):
+    """Stand-in train fn: 'accuracy' peaks at lr=0.1, units=32."""
+    acc = 1.0 - ((lr - 0.1) ** 2 + ((units - 32) / 64.0) ** 2)
+    if reporter is not None:
+        for step in range(3):
+            reporter.broadcast(acc * (step + 1) / 3.0, step=step)
+    return {"metric": acc, "lr": lr}
+
+
+def space():
+    return Searchspace(lr=("DOUBLE", [0.0, 0.2]), units=("INTEGER", [8, 64]))
+
+
+class TestRandomSearchE2E:
+    def test_full_run(self, local_env):
+        config = OptimizationConfig(
+            name="rs_e2e", num_trials=8, optimizer="randomsearch",
+            searchspace=space(), direction="max", num_workers=3,
+            hb_interval=0.05, seed=7, es_policy="none",
+        )
+        result = experiment.lagom(train_quadratic, config)
+        assert result["num_trials"] == 8
+        assert result["best_val"] is not None and result["best_val"] <= 1.0
+        assert result["best_val"] >= result["worst_val"]
+        # Artifacts on disk: experiment.json, result.json, per-trial dirs.
+        exp_dirs = os.listdir(local_env.base_dir)
+        assert len(exp_dirs) == 1
+        exp_dir = os.path.join(local_env.base_dir, exp_dirs[0])
+        assert json.loads(local_env.load(exp_dir + "/result.json"))["num_trials"] == 8
+        meta = json.loads(local_env.load(exp_dir + "/experiment.json"))
+        assert meta["state"] == "FINISHED"
+        trial_dirs = [d for d in os.listdir(exp_dir)
+                      if os.path.isdir(os.path.join(exp_dir, d))]
+        assert len(trial_dirs) == 8
+        for td in trial_dirs:
+            full = os.path.join(exp_dir, td)
+            assert os.path.exists(full + "/.hparams.json")
+            assert os.path.exists(full + "/.metric")
+            assert os.path.exists(full + "/trial.json")
+
+    def test_result_is_actually_best(self, local_env):
+        config = OptimizationConfig(
+            num_trials=6, optimizer="randomsearch", searchspace=space(),
+            direction="max", num_workers=2, hb_interval=0.05, seed=1,
+            es_policy="none",
+        )
+        result = experiment.lagom(train_quadratic, config)
+        # Recompute: reported best matches the true objective at best_hp.
+        hp = result["best_hp"]
+        expected = train_quadratic(hp["lr"], hp["units"])["metric"]
+        assert abs(expected - result["best_val"]) < 1e-9
+
+
+class TestGridSearchE2E:
+    def test_grid(self, local_env):
+        sp = Searchspace(pool=("DISCRETE", [2, 3]), act=("CATEGORICAL", ["relu", "gelu"]))
+
+        def train(pool, act):
+            return float(pool + (act == "gelu"))
+
+        config = OptimizationConfig(
+            optimizer="gridsearch", searchspace=sp, direction="max",
+            num_workers=2, hb_interval=0.05, es_policy="none",
+        )
+        result = experiment.lagom(train, config)
+        assert result["num_trials"] == 4
+        assert result["best_val"] == 4.0  # pool=3, gelu
+        assert result["best_hp"] == {"pool": 3, "act": "gelu"}
+
+
+class TestAshaE2E:
+    def test_asha(self, local_env):
+        def train(lr, units, budget, reporter=None):
+            # Budget-aware objective: converges toward lr with more budget.
+            return {"metric": lr * (1 - 1.0 / (1 + budget))}
+
+        config = OptimizationConfig(
+            optimizer=__import__("maggy_tpu.optimizers", fromlist=["Asha"]).Asha(
+                reduction_factor=3, resource_min=1, resource_max=9, seed=0),
+            num_trials=9, searchspace=space(), direction="max",
+            num_workers=3, hb_interval=0.05, es_policy="none",
+        )
+        result = experiment.lagom(train, config)
+        assert result["num_trials"] >= 9  # rung-0 + promotions
+        assert result["best_val"] > 0
+
+
+class TestFailureRecovery:
+    def test_failing_trial_marks_error_and_continues(self, local_env):
+        calls = []
+
+        def train(lr, units):
+            calls.append(lr)
+            if len(calls) == 2:
+                raise RuntimeError("boom")
+            return lr
+
+        config = OptimizationConfig(
+            num_trials=5, optimizer="randomsearch", searchspace=space(),
+            direction="max", num_workers=1, hb_interval=0.05, seed=3,
+            es_policy="none",
+        )
+        result = experiment.lagom(train, config)
+        # One trial errored; the rest finalized with metrics.
+        assert result["num_trials"] == 4
+        exp_dir = os.path.join(local_env.base_dir, os.listdir(local_env.base_dir)[0])
+        statuses = []
+        for d in os.listdir(exp_dir):
+            tj = os.path.join(exp_dir, d, "trial.json")
+            if os.path.exists(tj):
+                statuses.append(json.loads(local_env.load(tj))["status"])
+        assert statuses.count("ERROR") == 1
+        assert statuses.count("FINALIZED") == 4
+
+
+class TestEarlyStopE2E:
+    def test_median_rule_stops_bad_trials(self, local_env):
+        def train(lr, units, reporter=None):
+            # Bad configs (lr < 0.05) report low metrics slowly.
+            base = 1.0 if lr >= 0.05 else 0.01
+            for step in range(30):
+                reporter.broadcast(base * (step + 1) / 30.0, step=step)
+                time.sleep(0.01)
+            return base
+
+        config = OptimizationConfig(
+            num_trials=10, optimizer="randomsearch", searchspace=space(),
+            direction="max", num_workers=2, hb_interval=0.02, seed=5,
+            es_policy="median", es_interval=1, es_min=3,
+        )
+        result = experiment.lagom(train, config)
+        assert result["num_trials"] == 10
+        # At least one slow trial was early stopped, and its final metric is
+        # the last broadcast value, not the return value.
+        assert result["early_stopped"] >= 1
+
+
+class TestGuards:
+    def test_unknown_config_type(self):
+        with pytest.raises(TypeError, match="Unsupported config"):
+            experiment.lagom_driver(object(), "app", 0)
+
+    def test_unknown_optimizer(self):
+        from maggy_tpu.core.driver.optimization_driver import OptimizationDriver
+
+        with pytest.raises(ValueError, match="Unknown optimizer"):
+            OptimizationDriver(
+                OptimizationConfig(optimizer="sgd", searchspace=space()), "a", 0
+            )

@@ -1,0 +1,217 @@
+"""Control-plane tests: wire protocol, auth, reservations, server semantics.
+
+The reference has zero RPC coverage (SURVEY.md §4); its protocol is fully
+exercisable in-process with threads — done here against real localhost
+sockets.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from maggy_tpu.core.reporter import Reporter
+from maggy_tpu.core.rpc import (
+    Client,
+    DistributedServer,
+    MessageSocket,
+    OptimizationServer,
+    Reservations,
+    Server,
+)
+from maggy_tpu.exceptions import AuthenticationError, EarlyStopException
+from maggy_tpu.trial import Trial
+
+
+class TestWireProtocol:
+    def test_roundtrip(self):
+        a, b = socket.socketpair()
+        msg = {"type": "METRIC", "value": 1.5, "step": 3, "logs": ["x"], "nested": {"k": [1, 2]}}
+        MessageSocket.send_msg(a, msg, b"s3cret")
+        out = MessageSocket.recv_msg(b, b"s3cret")
+        assert out == msg
+        a.close(); b.close()
+
+    def test_bad_hmac_rejected(self):
+        a, b = socket.socketpair()
+        MessageSocket.send_msg(a, {"type": "REG"}, b"secret-A")
+        with pytest.raises(AuthenticationError):
+            MessageSocket.recv_msg(b, b"secret-B")
+        a.close(); b.close()
+
+    def test_large_frame(self):
+        a, b = socket.socketpair()
+        msg = {"type": "LOG", "blob": "x" * 1_000_000}
+        # Send from a thread: a 1 MB frame overflows the kernel socket buffer,
+        # so sendall needs a concurrent reader.
+        sender = threading.Thread(target=MessageSocket.send_msg, args=(a, msg, b"k"))
+        sender.start()
+        assert MessageSocket.recv_msg(b, b"k")["blob"] == msg["blob"]
+        sender.join()
+        a.close(); b.close()
+
+
+class TestReservations:
+    def test_barrier(self):
+        r = Reservations(required=2)
+        assert not r.done() and r.remaining() == 2
+        r.add({"partition_id": 0, "host_port": "h:1"})
+        r.add({"partition_id": 1, "host_port": "h:2"})
+        assert r.done()
+
+    def test_trial_assignment(self):
+        r = Reservations(required=1)
+        r.add({"partition_id": 0, "host_port": None})
+        assert r.get_assigned_trial(0) is None
+        r.assign_trial(0, "abc")
+        assert r.get_assigned_trial(0) == "abc"
+        r.assign_trial(0, None)
+        assert r.get_assigned_trial(0) is None
+
+
+class FakeDriver:
+    """Minimal driver double for server handler tests."""
+
+    def __init__(self):
+        self.messages = []
+        self.trials = {}
+        self.experiment_done = False
+
+    def enqueue(self, msg):
+        self.messages.append(msg)
+
+    def get_trial(self, trial_id):
+        return self.trials.get(trial_id)
+
+    def progress_snapshot(self):
+        return {"finalized": 0}
+
+
+@pytest.fixture
+def opt_server():
+    driver = FakeDriver()
+    server = OptimizationServer(num_executors=2)
+    server.attach_driver(driver)
+    addr = server.start()
+    yield server, driver, addr
+    server.stop()
+
+
+def make_client(addr, server, pid=0, hb=10.0):
+    return Client(addr, pid, 0, hb, server.secret_hex)
+
+
+class TestOptimizationServer:
+    def test_register_and_get_trial(self, opt_server):
+        server, driver, addr = opt_server
+        trial = Trial({"lr": 0.1})
+        driver.trials[trial.trial_id] = trial
+        client = make_client(addr, server)
+        client.register(host_port="x:1")
+        assert any(m["type"] == "REG" for m in driver.messages)
+        # No assignment yet -> OK/none; then assign and fetch.
+        server.reservations.assign_trial(0, trial.trial_id)
+        tid, params = client.get_suggestion(timeout=5)
+        assert tid == trial.trial_id and params == {"lr": 0.1}
+        assert trial.status == Trial.RUNNING
+        client.stop()
+
+    def test_metric_stop_roundtrip(self, opt_server):
+        server, driver, addr = opt_server
+        trial = Trial({"lr": 0.1})
+        driver.trials[trial.trial_id] = trial
+        client = make_client(addr, server, hb=0.05)
+        client.register()
+        reporter = Reporter()
+        reporter.reset(trial_id=trial.trial_id)
+        client.start_heartbeat(reporter)
+        reporter.broadcast(0.5, step=0)
+        trial.set_early_stop()
+        # Next heartbeat must deliver STOP -> reporter armed -> broadcast raises.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                reporter.broadcast(0.6, step=reporter.step + 1)
+                time.sleep(0.05)
+            except EarlyStopException as e:
+                assert e.metric >= 0.5
+                break
+        else:
+            pytest.fail("STOP never propagated to the reporter")
+        client.stop()
+
+    def test_gstop_when_done(self, opt_server):
+        server, driver, addr = opt_server
+        driver.experiment_done = True
+        client = make_client(addr, server)
+        client.register()
+        tid, params = client.get_suggestion()
+        assert tid is None and client.done
+        client.stop()
+
+    def test_reregistration_blacklists(self, opt_server):
+        server, driver, addr = opt_server
+        trial = Trial({"lr": 0.2})
+        driver.trials[trial.trial_id] = trial
+        c1 = make_client(addr, server, pid=0)
+        c1.register()
+        server.reservations.assign_trial(0, trial.trial_id)
+        # Same partition re-registers (simulating runner restart).
+        c2 = make_client(addr, server, pid=0)
+        c2.register()
+        deadline = time.monotonic() + 2
+        while time.monotonic() < deadline:
+            if any(m["type"] == "BLACK" and m["trial_id"] == trial.trial_id
+                   for m in driver.messages):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("BLACK message never enqueued")
+        # Reservation still holds the trial for re-run.
+        assert server.reservations.get_assigned_trial(0) == trial.trial_id
+        c1.stop(); c2.stop()
+
+    def test_wrong_secret_dropped(self, opt_server):
+        server, driver, addr = opt_server
+        sock = socket.create_connection(addr)
+        MessageSocket.send_msg(sock, {"type": "REG", "partition_id": 9}, b"wrong")
+        # Server drops the connection without reply.
+        sock.settimeout(1.0)
+        with pytest.raises((ConnectionError, socket.timeout, OSError)):
+            if sock.recv(1) == b"":
+                raise ConnectionError
+        assert server.reservations.get(9) is None
+        sock.close()
+
+
+class TestDistributedServer:
+    def test_rendezvous(self):
+        driver = FakeDriver()
+        server = DistributedServer(num_executors=2)
+        server.attach_driver(driver)
+        addr = server.start()
+        try:
+            c0 = make_client(addr, server, pid=0)
+            c1 = make_client(addr, server, pid=1)
+            c0.register(host_port="10.0.0.1:9999")
+            # Not all registered yet -> no config.
+            with pytest.raises(TimeoutError):
+                c1.get_dist_config(timeout=0.5)
+            c1.register(host_port="10.0.0.2:9999")
+            cfg = c1.get_dist_config(timeout=5)
+            assert cfg == {"coordinator_address": "10.0.0.1:9999", "num_processes": 2}
+            c0.stop(); c1.stop()
+        finally:
+            server.stop()
+
+
+class TestBarrier:
+    def test_await_reservations_timeout(self):
+        server = Server(num_executors=3)
+        server.start()
+        try:
+            with pytest.raises(TimeoutError, match="3 of 3"):
+                server.await_reservations(timeout=0.3)
+        finally:
+            server.stop()
