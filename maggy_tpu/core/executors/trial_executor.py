@@ -75,6 +75,15 @@ class TrialExecutor:
                 env.mkdir(trial_dir)
                 env.dump(util.json_dumps_safe(params), trial_dir + "/.hparams.json")
                 reporter.reset(trial_id=trial_id)
+                try:
+                    # Per-trial TensorBoard logdir + hparams record
+                    # (reference `trial_executor.py:122-133`).
+                    from maggy_tpu import tensorboard as tb
+
+                    tb._register(os.path.join(trial_dir, "tensorboard"))
+                    tb.write_hparams(params)
+                except Exception:  # noqa: BLE001 - TB must never kill a trial
+                    pass
 
                 call_params = dict(params)
                 if self.trial_type == "ablation":
@@ -108,6 +117,15 @@ class TrialExecutor:
                         )
                         reporter.reset()
         finally:
+            try:
+                # Flush the last trial's TensorBoard events (torch's writer
+                # only auto-flushes every 120 s — short final trials would
+                # lose their events otherwise).
+                from maggy_tpu import tensorboard as tb
+
+                tb._close()
+            except Exception:  # noqa: BLE001
+                pass
             client.stop()
 
 

@@ -1,0 +1,15 @@
+"""Model zoo: Flax models for the baseline configs (BASELINE.md).
+
+- `mnist_cnn`: MNIST Keras-CNN analogue (README example / random-search HPO)
+- `resnet`: ResNet for CIFAR-10 (ASHA sweep config)
+- `bert`: BERT-base-style encoder (GLUE fine-tune HPO config)
+- `llama`: Llama-style decoder + LoRA (the LoRA-sweep config; flagship)
+- `surgery`: ablatable-module helpers for LOCO model surgery
+"""
+
+from maggy_tpu.models.mnist_cnn import MnistCNN
+from maggy_tpu.models.resnet import ResNet
+from maggy_tpu.models.bert import BertEncoder, BertConfig
+from maggy_tpu.models.llama import Llama, LlamaConfig
+
+__all__ = ["MnistCNN", "ResNet", "BertEncoder", "BertConfig", "Llama", "LlamaConfig"]

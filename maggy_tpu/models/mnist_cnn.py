@@ -1,0 +1,40 @@
+"""MNIST CNN — the README random-search example model.
+
+Parity target: the reference's README example trains a Keras CNN whose
+kernel size / pooling size / dropout are the searched hyperparameters
+(`README.rst:56-84`). Flax version, hparam-parameterized the same way; NHWC
+with feature counts kept MXU-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    kernel_size: int = 3
+    pool_size: int = 2
+    dropout: float = 0.0
+    features: int = 32
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        k, p = self.kernel_size, self.pool_size
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.features, (k, k), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (p, p), strides=(p, p))
+        x = nn.Conv(self.features * 2, (k, k), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (p, p), strides=(p, p))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)

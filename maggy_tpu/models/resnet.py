@@ -1,0 +1,98 @@
+"""ResNet for CIFAR-10 (BASELINE.md config 3: "ResNet-50/CIFAR-10 ASHA sweep").
+
+Green-field Flax implementation (the reference has no model code): classic
+pre-activation basic/bottleneck blocks, NHWC, bfloat16-friendly, batch-norm
+statistics in fp32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=jnp.float32)
+        residual = x
+        y = nn.Conv(self.features, (3, 3), self.strides, use_bias=False,
+                    dtype=self.dtype)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), use_bias=False, dtype=self.dtype)(y)
+        y = norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = norm()(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=jnp.float32)
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(self.features, (3, 3), self.strides, use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features * 4, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = norm()(residual)
+        return nn.relu(residual + y)
+
+
+STAGE_SIZES = {
+    18: ([2, 2, 2, 2], BasicBlock),
+    34: ([3, 4, 6, 3], BasicBlock),
+    50: ([3, 4, 6, 3], BottleneckBlock),
+    101: ([3, 4, 23, 3], BottleneckBlock),
+}
+
+
+class ResNet(nn.Module):
+    depth: int = 50
+    num_classes: int = 10
+    width: int = 64
+    dtype: Any = jnp.float32
+    cifar_stem: bool = True  # 3x3 stem, no max-pool (32x32 inputs)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        stages, block_cls = STAGE_SIZES[self.depth]
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = nn.Conv(self.width, (3, 3), use_bias=False, dtype=self.dtype)(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), (2, 2), use_bias=False,
+                        dtype=self.dtype)(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        for i, n_blocks in enumerate(stages):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = block_cls(self.width * 2 ** i, strides,
+                              dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,56 @@
+"""Flax model surgery for LOCO ablation.
+
+The reference rebuilds Keras models from json with a layer removed
+(`loco.py:82-136`), never touching the first (input) or last (output) layer.
+Flax modules are code, not json — so ablation works on a declarative layer
+list: `AblatableSequential` skips layers whose names match the ablated set
+(exact names, or prefix for prefix groups), preserving first/last.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, FrozenSet, List, Sequence, Tuple
+
+import flax.linen as nn
+
+
+def filter_layers(
+    names: Sequence[str], ablated: FrozenSet[str]
+) -> List[str]:
+    """Names surviving ablation. A spec entry matches a layer by exact name
+    or as a prefix; first and last layers are always kept (reference
+    `loco.py:99-134`)."""
+    if not ablated:
+        return list(names)
+    kept = []
+    for i, name in enumerate(names):
+        protected = i == 0 or i == len(names) - 1
+        hit = any(name == a or name.startswith(a) for a in ablated)
+        if protected or not hit:
+            kept.append(name)
+    return kept
+
+
+class AblatableSequential(nn.Module):
+    """Sequential module over (name, make_layer) pairs with layer dropout by
+    name/prefix. ``layers`` must be a tuple of (str, callable-returning-module)
+    so the module stays hashable/comparable for Flax."""
+
+    layers: Tuple[Tuple[str, Callable[[], nn.Module]], ...]
+    ablated_layers: FrozenSet[str] = frozenset()
+
+    @nn.compact
+    def __call__(self, x, *args, **kwargs):
+        names = [n for n, _ in self.layers]
+        kept = set(filter_layers(names, self.ablated_layers))
+        for name, make in self.layers:
+            if name in kept:
+                x = make()(x)
+        return x
+
+
+def ablatable_model_generator(layers: Sequence[Tuple[str, Callable]],
+                              ablated_layers: FrozenSet[str] = frozenset()):
+    """Convenience base_model_generator for AblationStudy: returns an
+    AblatableSequential minus the ablated components."""
+    return AblatableSequential(tuple(layers), frozenset(ablated_layers))

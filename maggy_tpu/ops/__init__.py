@@ -1,0 +1,5 @@
+"""TPU compute kernels: Pallas where it pays, XLA elsewhere."""
+
+from maggy_tpu.ops.attention import multi_head_attention, flash_attention, attention_reference
+
+__all__ = ["multi_head_attention", "flash_attention", "attention_reference"]

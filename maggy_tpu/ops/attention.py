@@ -1,0 +1,249 @@
+"""Attention: Pallas flash kernel (TPU) with an XLA reference path.
+
+The reference framework has no attention code (SURVEY.md §5.7); this is the
+TPU-first hot-op design the BERT/Llama baseline configs need:
+
+- `flash_attention`: Pallas TPU kernel — tiled online-softmax forward, fp32
+  accumulators in VMEM scratch, causal block skipping via the grid, O(S)
+  memory. Backward is a flash-style recompute VJP (no S x S materialization
+  thanks to blockwise lax.map) — good enough until a Pallas bwd kernel lands.
+- `attention_reference`: straightforward XLA softmax attention (CPU tests,
+  odd shapes).
+- `multi_head_attention`: public entry — handles GQA (kv-head repeat),
+  dispatches to the kernel when shapes tile cleanly on a TPU backend.
+
+Kernel layout follows the pallas guide (/opt/skills/guides/pallas_guide.md):
+grid = (B*H, Sq/BLK_Q), K/V streamed block-by-block with `fori_loop`,
+(8,128)-aligned tiles, `preferred_element_type=float32` on every MXU dot.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+# ----------------------------------------------------------------- reference
+
+
+def attention_reference(q, k, v, causal: bool = True, mask=None):
+    """[B,S,H,D]x[B,S,Hkv,D] softmax attention in plain XLA (fp32 softmax)."""
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / jnp.sqrt(D).astype(jnp.float32)
+    if causal:
+        Sk = k.shape[1]
+        cm = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        logits = jnp.where(cm[None, None], logits, NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# -------------------------------------------------------------- pallas kernel
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_k, seq_k,
+                      causal, sm_scale):
+    """One (batch*head, q-block) program: stream K/V blocks, online softmax.
+
+    Refs: q [BLK_Q, D]; k/v [Sk, D] (full K/V for this head in VMEM);
+    o [BLK_Q, D]; lse [BLK_Q, 128] (lane-padded).
+    """
+    from jax.experimental import pallas as pl
+
+    blk_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+
+    num_kb = seq_k // blk_k
+
+    def body(kb, carry):
+        acc, m_i, l_i = carry
+        k_blk = k_ref[pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_i - m_new)
+        l_new = alpha * l_i + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((blk_q, d), jnp.float32)
+    m0 = jnp.full((blk_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((blk_q,), jnp.float32)
+    if causal:
+        # Only K blocks at or before this Q block's diagonal contribute.
+        last_kb = jnp.minimum(((qi + 1) * blk_q + blk_k - 1) // blk_k, num_kb)
+        acc, m_i, l_i = jax.lax.fori_loop(0, last_kb, body, (acc0, m0, l0))
+    else:
+        acc, m_i, l_i = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+
+    l_safe = jnp.maximum(l_i, 1e-30)
+    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse = (m_i + jnp.log(l_safe))
+    lse_ref[:] = jnp.broadcast_to(lse[:, None], lse_ref.shape)
+
+
+def _flash_fwd(q, k, v, causal: bool, blk_q: int, blk_k: int, interpret: bool):
+    """q,k,v: [BH, S, D] (kv already GQA-expanded). Returns (out, lse)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    sm_scale = 1.0 / (D ** 0.5)
+    grid = (BH, Sq // blk_q)
+    kernel = functools.partial(_flash_fwd_kernel, blk_k=blk_k, seq_k=Sk,
+                               causal=causal, sm_scale=sm_scale)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, blk_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, Sk, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, Sk, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, blk_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, blk_q, 128), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Sq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[:, :, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True, blk_q: int = 128,
+                    blk_k: int = 128, interpret: bool = False):
+    """Flash attention on [B,S,H,D] with H == Hkv (pre-expanded)."""
+    out, _ = _flash_fwd_4d(q, k, v, causal, blk_q, blk_k, interpret)
+    return out
+
+
+def _flash_fwd_4d(q, k, v, causal, blk_q, blk_k, interpret):
+    B, Sq, H, D = q.shape
+    to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)  # noqa: E731
+    out3, lse = _flash_fwd(to3(q), to3(k), to3(v), causal, blk_q, blk_k, interpret)
+    out = out3.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    return out, lse
+
+
+def _flash_fwd_rule(q, k, v, causal, blk_q, blk_k, interpret):
+    out, lse = _flash_fwd_4d(q, k, v, causal, blk_q, blk_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, blk_q, blk_k, interpret, res, g):
+    """Flash-style backward: recompute probabilities blockwise from the saved
+    log-sum-exp; never materializes the full S x S matrix."""
+    q, k, v, out, lse = res
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # [B,S,H]
+    lse4 = lse.reshape(B, H, Sq).transpose(0, 2, 1)  # [B,S,H]
+
+    n_blocks = max(1, Sq // blk_q)
+
+    def block_grads(qb_idx):
+        sl = lambda x: jax.lax.dynamic_slice_in_dim(x, qb_idx * blk_q, blk_q, 1)  # noqa: E731
+        qb, gb = sl(qf), sl(gf)
+        lseb, deltab = sl(lse4), sl(delta)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qb, kf) * scale
+        if causal:
+            q_pos = qb_idx * blk_q + jnp.arange(blk_q)
+            cm = q_pos[:, None] >= jnp.arange(Sk)[None, :]
+            s = jnp.where(cm[None, None], s, NEG_INF)
+        p = jnp.exp(s - lseb.transpose(0, 2, 1)[:, :, :, None])
+        dv_b = jnp.einsum("bhqk,bqhd->bkhd", p, gb)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", gb, vf)
+        ds = p * (dp - deltab.transpose(0, 2, 1)[:, :, :, None]) * scale
+        dq_b = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+        dk_b = jnp.einsum("bhqk,bqhd->bkhd", ds, qb)
+        return dq_b, dk_b, dv_b
+
+    dq_blocks, dk_blocks, dv_blocks = jax.lax.map(
+        block_grads, jnp.arange(n_blocks))
+    # dq_blocks: [n_blocks, B, blk_q, H, D] -> [B, Sq, H, D]
+    dq = dq_blocks.transpose(1, 0, 2, 3, 4).reshape(B, Sq, H, D)
+    dk = jnp.sum(dk_blocks, axis=0)
+    dv = jnp.sum(dv_blocks, axis=0)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ----------------------------------------------------------------- dispatch
+
+
+def _tpu_backend() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def multi_head_attention(q, k, v, causal: bool = True, mask=None,
+                         force: Optional[str] = None):
+    """Public attention entry: GQA expand + kernel dispatch.
+
+    q: [B,S,H,D], k/v: [B,S,Hkv,D]. ``force`` in {"flash", "reference"}
+    overrides dispatch (tests).
+    """
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    # The kernel's causal mask assumes Sq == Sk (absolute positions); the
+    # blk_k loop assumes Sk tiles exactly. Violations fall back (or raise
+    # under force=) instead of silently mis-masking/truncating.
+    tiles_ok = (
+        mask is None and D % 128 == 0 and Sq == k.shape[1] and Sq % 128 == 0
+    )
+    if force == "flash":
+        if not tiles_ok:
+            raise ValueError(
+                "force='flash' requires mask=None, D%128==0, and Sq==Sk with "
+                "Sq%128==0; got D={}, Sq={}, Sk={}, mask={}".format(
+                    D, Sq, k.shape[1], mask is not None))
+        use_flash = True
+    else:
+        use_flash = force is None and _tpu_backend() and tiles_ok
+    if not use_flash:
+        return attention_reference(q, k, v, causal=causal, mask=mask)
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    blk = 128 if Sq % 128 == 0 else Sq
+    interpret = not _tpu_backend()
+    return flash_attention(q, k, v, causal, min(blk, Sq), min(128, k.shape[1]),
+                           interpret)

@@ -80,6 +80,30 @@ def shard_params(mesh, params, strategy: str = "dp"):
     )
 
 
+def logical_axis_rules(strategy: str = "dp"):
+    """Logical-axis -> mesh-axis rules for the model zoo's
+    `nn.with_logical_partitioning` annotations (llama.py/bert.py).
+
+    - dp:    everything replicated
+    - fsdp:  embed dim sharded over "fsdp" (ZeRO-3)
+    - tp:    head/mlp/vocab dims sharded over "model" (Megatron)
+    - fsdp_tp: both
+    """
+    if strategy == "dp":
+        return [("embed", None), ("mlp", None), ("heads", None),
+                ("kv", None), ("vocab", None)]
+    if strategy == "fsdp":
+        return [("embed", "fsdp"), ("mlp", None), ("heads", None),
+                ("kv", None), ("vocab", None)]
+    if strategy == "tp":
+        return [("embed", None), ("mlp", "model"), ("heads", "model"),
+                ("kv", "model"), ("vocab", "model")]
+    if strategy == "fsdp_tp":
+        return [("embed", "fsdp"), ("mlp", "model"), ("heads", "model"),
+                ("kv", "model"), ("vocab", "model")]
+    raise ValueError("Unknown strategy {!r}".format(strategy))
+
+
 def batch_sharding(mesh, ndim: int = 2):
     """Batch sharded over every data-like axis on dim 0, replicated after."""
     import jax

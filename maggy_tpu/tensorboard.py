@@ -1,0 +1,89 @@
+"""Per-trial TensorBoard integration.
+
+Parity: reference `maggy/tensorboard.py` — module-global logdir registered
+per trial (:25-44), HParams-plugin experiment config for the searchspace
+(:75-87) and per-trial hparams (:90-93). Implemented over
+`torch.utils.tensorboard` (bundled; avoids importing full TF) with a JSON
+fallback, plus `jax.profiler` trace capture as the idiomatic TPU addition
+(SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+_logdir: Optional[str] = None
+_writer = None
+
+
+def _make_writer(logdir: str):
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter(log_dir=logdir)
+    except Exception:  # noqa: BLE001 - TB optional; JSON fallback below
+        return None
+
+
+def _register(trial_logdir: str) -> None:
+    """Called by the trial executor when a trial starts."""
+    global _logdir, _writer
+    _close()
+    os.makedirs(trial_logdir, exist_ok=True)
+    _logdir = trial_logdir
+    _writer = _make_writer(trial_logdir)
+
+
+def _close() -> None:
+    global _writer, _logdir
+    if _writer is not None:
+        try:
+            _writer.close()
+        except Exception:  # noqa: BLE001
+            pass
+    _writer = None
+    _logdir = None
+
+
+def logdir() -> str:
+    """The current trial's TensorBoard logdir (reference `tensorboard.py:33`)."""
+    if _logdir is None:
+        raise RuntimeError("No trial logdir registered; are you inside a trial?")
+    return _logdir
+
+
+def add_scalar(tag: str, value: float, step: int = 0) -> None:
+    if _writer is not None:
+        _writer.add_scalar(tag, value, step)
+    elif _logdir is not None:
+        with open(os.path.join(_logdir, "scalars.jsonl"), "a") as f:
+            f.write(json.dumps({"tag": tag, "value": float(value), "step": step}) + "\n")
+
+
+def write_hparams(hparams: Dict[str, Any], metrics: Optional[Dict[str, float]] = None) -> None:
+    """Per-trial hparams record (reference `tensorboard.py:90-93`)."""
+    if _logdir is None:
+        return
+    if _writer is not None:
+        clean = {k: v if isinstance(v, (int, float, str, bool)) else str(v)
+                 for k, v in hparams.items()}
+        _writer.add_hparams(clean, metrics or {}, run_name=".")
+    else:
+        with open(os.path.join(_logdir, "hparams.json"), "w") as f:
+            json.dump(hparams, f, default=str)
+
+
+def start_trace(trace_dir: Optional[str] = None) -> None:
+    """Capture a jax.profiler trace into the trial logdir (viewable in
+    TensorBoard's profile plugin)."""
+    import jax
+
+    jax.profiler.start_trace(trace_dir or logdir())
+
+
+def stop_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()

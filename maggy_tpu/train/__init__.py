@@ -1,0 +1,10 @@
+from maggy_tpu.train.trainer import (
+    cross_entropy_loss,
+    init_train_state,
+    make_train_step,
+    Trainer,
+)
+from maggy_tpu.train.data import ShardedBatchIterator
+
+__all__ = ["cross_entropy_loss", "init_train_state", "make_train_step",
+           "Trainer", "ShardedBatchIterator"]

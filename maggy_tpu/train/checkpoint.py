@@ -1,0 +1,60 @@
+"""Per-trial checkpoint/resume via orbax.
+
+Parity gap being closed (SURVEY.md §5.4): the reference has NO model-state
+checkpointing — a promoted ASHA trial re-runs from scratch (noted at
+`hyperband.py:325-326` as a wanted optimization). Here each trial dir can
+hold an orbax checkpoint; a promoted trial restores its parent's state and
+continues training at the bigger budget, which is a direct trials/hour win.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+class TrialCheckpointer:
+    def __init__(self, trial_dir: str, max_to_keep: int = 1):
+        import orbax.checkpoint as ocp
+
+        self.path = os.path.abspath(os.path.join(trial_dir, "checkpoints"))
+        self.manager = ocp.CheckpointManager(
+            self.path,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = True) -> None:
+        import orbax.checkpoint as ocp
+
+        self.manager.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self.manager.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(self, abstract_state: Any, step: Optional[int] = None) -> Any:
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self.manager.latest_step()
+        if step is None:
+            return None
+        return self.manager.restore(
+            step, args=ocp.args.StandardRestore(abstract_state))
+
+    def close(self) -> None:
+        self.manager.close()
+
+
+def restore_parent_state(exp_dir: str, parent_trial_id: str,
+                         abstract_state: Any) -> Optional[Any]:
+    """Warm-start a promoted trial from its parent's checkpoint (the ASHA
+    promotion carries `info_dict["parent"]`)."""
+    parent_dir = os.path.join(exp_dir, parent_trial_id)
+    if not os.path.isdir(os.path.join(parent_dir, "checkpoints")):
+        return None
+    ckpt = TrialCheckpointer(parent_dir)
+    try:
+        return ckpt.restore(abstract_state)
+    finally:
+        ckpt.close()

@@ -1,0 +1,183 @@
+"""Sharded training: init + jitted step over a named mesh.
+
+This is the TPU data plane the reference delegates to torch DDP
+(`dist_executor.py:102,197-223`): params are initialized straight into their
+GSPMD shardings (derived from the model zoo's logical annotations), the
+train step is one jit with donated state, and XLA emits the gradient
+collectives over ICI — there is no wrapper class around the model.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from maggy_tpu.parallel.sharding import batch_sharding, logical_axis_rules
+
+
+def cross_entropy_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def next_token_loss(logits, tokens):
+    """Causal LM loss: predict tokens[t+1] from logits[t]."""
+    return cross_entropy_loss(logits[:, :-1], tokens[:, 1:])
+
+
+def _unbox_and_specs(variables, mesh, strategy):
+    """Split flax's Partitioned boxes into (plain pytree, NamedShardings)."""
+    import flax.linen as nn
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rules = dict(logical_axis_rules(strategy))
+
+    def to_sharding(leaf):
+        if isinstance(leaf, nn.Partitioned):
+            spec = tuple(rules.get(n, None) if n else None for n in leaf.names)
+            # Drop mesh axes that don't exist on this mesh.
+            spec = tuple(s if s in mesh.axis_names else None for s in spec)
+            return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    shardings = jax.tree_util.tree_map(
+        to_sharding, variables,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned))
+    plain = jax.tree_util.tree_map(
+        lambda x: x.value if isinstance(x, nn.Partitioned) else x,
+        variables, is_leaf=lambda x: isinstance(x, nn.Partitioned))
+    return plain, shardings
+
+
+def init_train_state(
+    model,
+    tx,
+    rng,
+    example_inputs: Tuple,
+    mesh,
+    strategy: str = "dp",
+    init_kwargs: Optional[Dict[str, Any]] = None,
+):
+    """Initialize (params, opt_state) directly INTO their shardings.
+
+    Returns (params, opt_state, shardings) where params is the full flax
+    variables dict minus boxes.
+    """
+    init_kwargs = init_kwargs or {}
+
+    def init_fn(rng):
+        return model.init(rng, *example_inputs, **init_kwargs)
+
+    abstract = jax.eval_shape(init_fn, rng)
+    _, shardings = _unbox_and_specs(abstract, mesh, strategy)
+
+    def init_unboxed(rng):
+        variables = init_fn(rng)
+        plain, _ = _unbox_and_specs(variables, mesh, strategy)
+        return plain
+
+    with mesh:
+        params = jax.jit(init_unboxed, out_shardings=shardings)(rng)
+        opt_state = tx.init(params["params"] if "params" in params else params)
+    return params, opt_state, shardings
+
+
+def make_train_step(
+    model,
+    tx,
+    loss_fn: Callable,
+    mesh,
+    donate: bool = True,
+    has_aux_collections: bool = False,
+    train_kwargs: Optional[Dict[str, Any]] = None,
+):
+    """Build the jitted SPMD train step.
+
+    step(variables, opt_state, batch) -> (variables, opt_state, loss).
+    ``loss_fn(logits_or_outputs, batch)`` computes the scalar loss; gradient
+    all-reduce/reduce-scatter over the mesh comes from GSPMD.
+    """
+    train_kwargs = train_kwargs or {}
+
+    def step(variables, opt_state, batch):
+        params = variables["params"]
+        aux = {k: v for k, v in variables.items() if k != "params"}
+
+        def compute_loss(p):
+            vs = {"params": p, **aux}
+            if has_aux_collections:
+                out, updates = model.apply(
+                    vs, *batch["inputs"], mutable=list(aux.keys()),
+                    **train_kwargs)
+                return loss_fn(out, batch), updates
+            out = model.apply(vs, *batch["inputs"], **train_kwargs)
+            return loss_fn(out, batch), {}
+
+        (loss, new_aux), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        return {"params": params, **new_aux} if has_aux_collections else \
+            {"params": params, **aux}, opt_state, loss
+
+    jit_kwargs = {}
+    if donate:
+        jit_kwargs["donate_argnums"] = (0, 1)
+    return jax.jit(step, **jit_kwargs)
+
+
+class Trainer:
+    """Convenience loop: init + step + reporter integration.
+
+    The per-trial training harness for HPO sweeps (models from the zoo,
+    optax optimizer, metric heartbeats via the Reporter).
+    """
+
+    def __init__(self, model, tx, loss_fn, mesh, strategy: str = "dp",
+                 train_kwargs: Optional[Dict[str, Any]] = None,
+                 has_aux_collections: bool = False):
+        self.model = model
+        self.tx = tx
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.strategy = strategy
+        self._step = make_train_step(model, tx, loss_fn, mesh,
+                                     train_kwargs=train_kwargs,
+                                     has_aux_collections=has_aux_collections)
+        self.variables = None
+        self.opt_state = None
+        self.shardings = None
+
+    def init(self, rng, example_inputs, init_kwargs=None):
+        self.variables, self.opt_state, self.shardings = init_train_state(
+            self.model, self.tx, rng, example_inputs, self.mesh,
+            self.strategy, init_kwargs=init_kwargs)
+        return self
+
+    def place_batch(self, batch: Dict[str, Any]):
+        def put(x):
+            sh = batch_sharding(self.mesh, np.ndim(x))
+            return jax.device_put(jnp.asarray(x), sh)
+
+        return jax.tree_util.tree_map(put, batch)
+
+    def step(self, batch: Dict[str, Any]) -> float:
+        with self.mesh:
+            self.variables, self.opt_state, loss = self._step(
+                self.variables, self.opt_state, batch)
+        return loss
+
+    def fit(self, batches, reporter=None, report_every: int = 1) -> float:
+        loss = None
+        for i, batch in enumerate(batches):
+            loss = self.step(self.place_batch(batch))
+            if reporter is not None and i % report_every == 0:
+                reporter.broadcast(float(loss), step=i)
+        return float(loss) if loss is not None else float("nan")

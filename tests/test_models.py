@@ -1,0 +1,215 @@
+"""Model zoo + ops + trainer tests on the virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from maggy_tpu.models import BertConfig, BertEncoder, Llama, LlamaConfig, MnistCNN, ResNet
+from maggy_tpu.models.surgery import AblatableSequential, filter_layers
+from maggy_tpu.ops.attention import attention_reference, flash_attention
+from maggy_tpu.parallel import make_mesh
+from maggy_tpu.train import ShardedBatchIterator, Trainer, cross_entropy_loss
+from maggy_tpu.train.trainer import next_token_loss
+
+
+class TestAttention:
+    def test_flash_matches_reference(self):
+        rng = np.random.default_rng(0)
+        B, S, H, D = 2, 256, 2, 128
+        q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+                   for _ in range(3))
+        ref = attention_reference(q, k, v, causal=True)
+        fl = flash_attention(q, k, v, True, 128, 128, True)  # interpret on CPU
+        assert float(jnp.abs(ref - fl).max()) < 1e-4
+
+    def test_flash_gradients_match(self):
+        rng = np.random.default_rng(1)
+        B, S, H, D = 1, 256, 2, 128
+        q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+                   for _ in range(3))
+        g_ref = jax.grad(lambda q, k, v: jnp.sum(
+            attention_reference(q, k, v, causal=True) ** 2), (0, 1, 2))(q, k, v)
+        g_fl = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, True, 128, 128, True) ** 2), (0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_fl):
+            assert float(jnp.abs(a - b).max()) < 1e-3
+
+
+class TestModelsForward:
+    def test_mnist_cnn(self):
+        model = MnistCNN(kernel_size=3, pool_size=2)
+        x = jnp.ones((2, 28, 28, 1))
+        params = model.init(jax.random.key(0), x)
+        out = model.apply(params, x)
+        assert out.shape == (2, 10)
+
+    def test_resnet18(self):
+        model = ResNet(depth=18, num_classes=10, width=16)
+        x = jnp.ones((2, 32, 32, 3))
+        variables = model.init(jax.random.key(0), x)
+        out = model.apply(variables, x)
+        assert out.shape == (2, 10)
+        assert "batch_stats" in variables
+
+    def test_bert_tiny(self):
+        cfg = BertConfig.tiny(num_classes=3)
+        model = BertEncoder(cfg)
+        tokens = jnp.ones((2, 16), jnp.int32)
+        variables = model.init(jax.random.key(0), tokens)
+        out = model.apply(variables, tokens)
+        assert out.shape == (2, 3)
+        assert out.dtype == jnp.float32
+
+    def test_llama_tiny_forward(self):
+        cfg = LlamaConfig.tiny()
+        model = Llama(cfg)
+        tokens = jnp.ones((2, 16), jnp.int32)
+        variables = model.init(jax.random.key(0), tokens)
+        logits = model.apply(variables, tokens)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+
+    def test_llama_lora_params_exist(self):
+        cfg = LlamaConfig.tiny(lora_rank=4)
+        model = Llama(cfg)
+        variables = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))
+        flat = jax.tree_util.tree_leaves_with_path(variables)
+        lora_leaves = [p for p, _ in flat if any("lora" in str(k) for k in p)]
+        assert lora_leaves  # adapters present
+        # lora_b zero-init -> the adapter contributes exactly nothing at
+        # init, so a rank-4 model with the SAME base weights must produce
+        # identical logits to the rank-0 model.
+        cfg0 = LlamaConfig.tiny(lora_rank=0)
+        v0 = Llama(cfg0).init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))
+        out0 = Llama(cfg0).apply(v0, jnp.ones((1, 8), jnp.int32))
+        out1 = model.apply(variables, jnp.ones((1, 8), jnp.int32))
+        # Graft the LoRA model's base weights onto the rank-0 structure to
+        # compare apples to apples (init rng streams differ across configs).
+        import flax
+
+        flat1 = flax.traverse_util.flatten_dict(variables["params"])
+        base1 = {k: v for k, v in flat1.items()
+                 if "lora_a" not in k and "lora_b" not in k}
+        v0_graft = {"params": flax.traverse_util.unflatten_dict(base1)}
+        out0g = Llama(cfg0).apply(v0_graft, jnp.ones((1, 8), jnp.int32))
+        assert jnp.allclose(out0g, out1, atol=1e-5)
+        assert out0.shape == out1.shape
+
+
+class TestSurgery:
+    def test_filter_layers(self):
+        names = ["stem", "block_1", "block_2", "dense", "head"]
+        assert filter_layers(names, frozenset()) == names
+        assert filter_layers(names, frozenset(["block_1"])) == \
+            ["stem", "block_2", "dense", "head"]
+        # prefix group drops both blocks
+        assert filter_layers(names, frozenset(["block"])) == \
+            ["stem", "dense", "head"]
+        # first/last always protected
+        assert filter_layers(names, frozenset(["stem", "head"])) == names
+
+    def test_ablatable_sequential(self):
+        import flax.linen as nn
+
+        layers = (
+            ("inp", lambda: nn.Dense(8)),
+            ("mid_a", lambda: nn.Dense(8)),
+            ("mid_b", lambda: nn.Dense(8)),
+            ("out", lambda: nn.Dense(2)),
+        )
+        full = AblatableSequential(layers)
+        ablated = AblatableSequential(layers, frozenset(["mid_a"]))
+        x = jnp.ones((1, 4))
+        vf = full.init(jax.random.key(0), x)
+        va = ablated.init(jax.random.key(0), x)
+        n_full = len(jax.tree_util.tree_leaves(vf))
+        n_abl = len(jax.tree_util.tree_leaves(va))
+        assert n_abl == n_full - 2  # one Dense (kernel+bias) removed
+        assert ablated.apply(va, x).shape == (1, 2)
+
+
+class TestTrainer:
+    def test_mnist_trainer_converges_dp(self):
+        mesh = make_mesh({"data": 8})
+        rng = np.random.default_rng(0)
+        # Tiny synthetic "MNIST": class = brightest quadrant.
+        X = rng.normal(size=(256, 8, 8, 1)).astype(np.float32)
+        y = (X.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+        model = MnistCNN(kernel_size=3, pool_size=2, features=8, num_classes=2)
+        trainer = Trainer(
+            model, optax.adam(1e-2),
+            lambda logits, batch: cross_entropy_loss(logits, batch["labels"]),
+            mesh, strategy="dp",
+        )
+        trainer.init(jax.random.key(0), (jnp.zeros((1, 8, 8, 1)),))
+
+        def batches():
+            it = ShardedBatchIterator({"x": X, "y": y}, batch_size=64,
+                                      epochs=8, seed=1)
+            for b in it:
+                yield {"inputs": (b["x"],), "labels": b["y"]}
+
+        final_loss = trainer.fit(batches())
+        assert final_loss < 0.35
+
+    def test_bert_init_fsdp(self):
+        """Regression: the pooler's kernel axes must not map both dims to the
+        same mesh axis under fsdp (duplicate-axis PartitionSpec)."""
+        import optax
+
+        mesh = make_mesh({"fsdp": 8})
+        cfg = BertConfig.tiny()
+        model = BertEncoder(cfg)
+        trainer = Trainer(
+            model, optax.adam(1e-3),
+            lambda logits, batch: cross_entropy_loss(logits, batch["labels"]),
+            mesh, strategy="fsdp",
+        )
+        trainer.init(jax.random.key(0), (jnp.ones((1, 8), jnp.int32),))
+        assert trainer.variables is not None
+
+    def test_llama_train_step_fsdp_tp(self):
+        """Full sharded train step: tiny Llama on a 2x2x2 dp/fsdp/model mesh."""
+        mesh = make_mesh({"data": 2, "fsdp": 2, "model": 2})
+        cfg = LlamaConfig.tiny()
+        model = Llama(cfg)
+        trainer = Trainer(
+            model, optax.adamw(1e-3),
+            lambda logits, batch: next_token_loss(logits, batch["tokens"]),
+            mesh, strategy="fsdp_tp",
+        )
+        trainer.init(jax.random.key(0), (jnp.ones((1, 16), jnp.int32),))
+        # Params actually sharded: find a leaf with a non-trivial spec.
+        from jax.sharding import PartitionSpec as P
+
+        specs = jax.tree_util.tree_map(
+            lambda x: x.sharding.spec, trainer.variables)
+        non_trivial = [s for s in jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda s: isinstance(s, P)) if s != P()]
+        assert non_trivial, "no parameter was sharded under fsdp_tp"
+        tokens = np.ones((4, 16), np.int32)
+        losses = [float(trainer.step(trainer.place_batch(
+            {"inputs": (jnp.asarray(tokens),), "tokens": jnp.asarray(tokens)})))
+            for _ in range(3)]
+        assert losses[-1] < losses[0]  # it learns (memorizes)
+
+
+class TestShardedData:
+    def test_disjoint_shards_cover_dataset(self):
+        X = np.arange(100)
+        seen = []
+        for shard in range(4):
+            it = ShardedBatchIterator({"x": X}, batch_size=5, shard_count=4,
+                                      current_shard=shard, shuffle=True,
+                                      seed=3, epochs=1)
+            for b in it:
+                seen.extend(b["x"].tolist())
+        assert len(seen) == len(set(seen)) == 100
+
+    def test_len_and_remainder(self):
+        X = np.arange(103)
+        it = ShardedBatchIterator({"x": X}, batch_size=10, epochs=1,
+                                  drop_remainder=True, shuffle=False)
+        assert len(it) == 10
+        assert sum(1 for _ in it) == 10
