@@ -1,0 +1,52 @@
+"""Runtime-mode detection.
+
+Parity: reference `maggy/core/config.py:17-37` detects HOPSWORKS vs
+SPARK_ONLY from env vars at import. TPU-native equivalent: LOCAL vs TPU_VM
+vs TPU_POD, from the TPU runtime's env markers — used for runner-pool and
+environment defaults. Detection is lazy (a function, not import-time state)
+so tests can monkeypatch the environment.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Literal
+
+Mode = Literal["LOCAL", "TPU_VM", "TPU_POD"]
+
+
+def detect_mode() -> Mode:
+    """LOCAL (no TPU), TPU_VM (single host with chips), or TPU_POD
+    (multi-host slice)."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if hostnames and len(hostnames.split(",")) > 1:
+        return "TPU_POD"
+    if _has_tpu():
+        return "TPU_VM"
+    return "LOCAL"
+
+
+def _has_tpu() -> bool:
+    if os.environ.get("TPU_SKIP_MDS_QUERY") or os.environ.get("TPU_WORKER_ID"):
+        return True
+    try:
+        import jax
+
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def default_pool_type() -> str:
+    """Sensible runner-pool default for the detected mode."""
+    return "thread" if detect_mode() == "LOCAL" else "tpu"
+
+
+def num_local_chips() -> int:
+    try:
+        import jax
+
+        return len([d for d in jax.local_devices()
+                    if d.platform in ("tpu", "axon")])
+    except Exception:  # noqa: BLE001
+        return 0

@@ -47,7 +47,9 @@ MAX_FRAME = 64 * 1024 * 1024
 
 
 def _sign(secret: bytes, payload: bytes) -> bytes:
-    return hmac.new(secret, payload, hashlib.sha256).digest()
+    from maggy_tpu import native
+
+    return native.hmac_sha256(secret, payload)
 
 
 class MessageSocket:
@@ -159,6 +161,12 @@ class Server:
         }
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+        # Warm the native codec BEFORE the event loop exists: the lazy g++
+        # build (up to ~minutes on a loaded host) must not run inside the
+        # single server thread while registrations queue up.
+        from maggy_tpu import native
+
+        native.get_lib()
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind((host, port))
@@ -199,21 +207,22 @@ class Server:
             self._dispatch(conn, frame)
 
     def _try_extract_frame(self, conn, buf: bytearray):
-        """Pop one complete authenticated frame from the buffer, or None."""
+        """Pop one complete authenticated frame from the buffer, or None.
+
+        Scanning + HMAC verification run in the native codec
+        (native/framing.cpp) when built; -1/-2 results (oversized frame /
+        MAC mismatch) drop the connection."""
+        from maggy_tpu import native
+
+        result = native.frame_scan(buf, self.secret, MAX_FRAME)
+        if result == 0:
+            return None
+        if result < 0:
+            self._drop(conn)
+            return None
         header = 4 + 32
-        if len(buf) < header:
-            return None
-        (length,) = _LEN.unpack(bytes(buf[:4]))
-        if length > MAX_FRAME:
-            self._drop(conn)
-            return None
-        if len(buf) < header + length:
-            return None
-        mac, payload = bytes(buf[4:header]), bytes(buf[header:header + length])
-        del buf[: header + length]
-        if not hmac.compare_digest(mac, _sign(self.secret, payload)):
-            self._drop(conn)
-            return None
+        payload = bytes(buf[header:result])
+        del buf[:result]
         return payload
 
     def _dispatch(self, conn, payload: bytes):

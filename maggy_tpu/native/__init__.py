@@ -1,0 +1,114 @@
+"""Native (C++) control-plane codec, loaded via ctypes.
+
+Builds `framing.cpp` into `_maggy_native.so` with g++ on first import (cached
+next to the source); every entry point has a pure-Python fallback so the
+framework works without a toolchain. See framing.cpp for what/why.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import hmac as _py_hmac
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "framing.cpp")
+_SO = os.path.join(_HERE, "_maggy_native.so")
+
+_lib = None
+_lock = threading.Lock()
+_build_attempted = False
+
+
+def _build() -> bool:
+    # Compile to a per-pid temp path then rename: os.rename is atomic, so
+    # concurrent runner processes never dlopen a partially written .so.
+    tmp = "{}.tmp.{}".format(_SO, os.getpid())
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, _SO)
+        return True
+    except Exception:  # noqa: BLE001 - no toolchain -> python fallback
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def get_lib():
+    """The loaded native library, or None (fallback mode)."""
+    global _lib, _build_attempted
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if _build_attempted:
+                return None
+            _build_attempted = True
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.maggy_hmac_sha256.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.c_char_p]
+        lib.maggy_hmac_sha256.restype = None
+        lib.maggy_digest_eq.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+        lib.maggy_digest_eq.restype = ctypes.c_int
+        lib.maggy_frame_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.c_size_t]
+        lib.maggy_frame_scan.restype = ctypes.c_long
+        _lib = lib
+        return _lib
+
+
+def hmac_sha256(key: bytes, msg: bytes) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        return _py_hmac.new(key, msg, hashlib.sha256).digest()
+    out = ctypes.create_string_buffer(32)
+    lib.maggy_hmac_sha256(key, len(key), msg, len(msg), out)
+    return out.raw
+
+
+def frame_scan(buf, key: bytes, max_frame: int) -> int:
+    """Scan one frame: >0 total size consumed (valid), 0 incomplete,
+    -1 oversized, -2 bad HMAC. Pure-Python fallback mirrors framing.cpp."""
+    lib = get_lib()
+    if lib is not None:
+        if isinstance(buf, bytearray):
+            # Zero-copy view into the connection's reassembly buffer — this
+            # runs once per frame on the server's single event-loop thread.
+            cbuf = (ctypes.c_char * len(buf)).from_buffer(buf)
+            return int(lib.maggy_frame_scan(cbuf, len(buf), key, len(key),
+                                            max_frame))
+        return int(lib.maggy_frame_scan(bytes(buf), len(buf), key, len(key),
+                                        max_frame))
+    header = 4 + 32
+    if len(buf) < header:
+        return 0
+    length = int.from_bytes(buf[:4], "big")
+    if length > max_frame:
+        return -1
+    if len(buf) < header + length:
+        return 0
+    mac = _py_hmac.new(key, bytes(buf[header:header + length]),
+                       hashlib.sha256).digest()
+    if not _py_hmac.compare_digest(mac, bytes(buf[4:header])):
+        return -2
+    return header + length
+
+
+def is_native() -> bool:
+    return get_lib() is not None

@@ -1,0 +1,118 @@
+"""Ring attention: sequence/context parallelism over a "seq" mesh axis.
+
+Long-context training support the reference entirely lacks (SURVEY.md §5.7).
+Design (blockwise ring attention, Liu et al. 2023): Q/K/V are sharded along
+the sequence axis across devices; each device holds its Q shard and, over
+`seq`-axis ring steps, receives successive K/V shards via `jax.lax.ppermute`
+(ICI neighbor exchange), accumulating attention with a numerically-stable
+online softmax. Peak memory per device is O(S/n) and the K/V transfer
+overlaps compute under XLA's async collectives.
+
+Causal masking is block-aware: a device skips K/V shards strictly in its
+future; the diagonal shard applies the intra-block triangular mask.
+Implemented with `shard_map` so it runs identically on a CPU test mesh and a
+TPU pod; the per-shard inner attention reuses the Pallas flash kernel when
+shapes tile (ops/attention.py).
+"""
+
+from __future__ import annotations
+
+
+
+import jax
+import jax.numpy as jnp
+
+from maggy_tpu.ops.attention import NEG_INF
+
+
+def _block_attend(q, k, v, q_offset, k_offset, causal, sm_scale):
+    """Online-softmax partial attention of one (Q shard, K/V shard) pair.
+
+    q: [B,Sq,H,D], k/v: [B,Sk,H,D]; returns (acc [B,Sq,H,D] fp32,
+    m [B,Sq,H] fp32, l [B,Sq,H] fp32) partial-softmax statistics.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B,H,Sq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return acc, m.transpose(0, 2, 1), l.transpose(0, 2, 1)
+
+
+def _merge(acc1, m1, l1, acc2, m2, l2):
+    """Merge two partial online-softmax states."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    # acc layout [B,Sq,H,D]; m/l are [B,Sq,H]
+    acc = acc1 * a1[..., None] + acc2 * a2[..., None]
+    l = l1 * a1 + l2 * a2
+    return acc, m, l
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "seq",
+                   causal: bool = True):
+    """Sequence-parallel attention. q/k/v: [B, S, H, D] GLOBALLY, sharded on
+    dim 1 over ``axis_name``. Returns out with the same sharding.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis_name]
+    B, S, H, D = q.shape
+    if S % n:
+        raise ValueError("Sequence length {} must divide over {} '{}' shards"
+                         .format(S, n, axis_name))
+    shard = S // n
+    sm_scale = 1.0 / (D ** 0.5)
+
+    def local_fn(q_blk, k_blk, v_blk):
+        idx = jax.lax.axis_index(axis_name)
+        q_off = idx * shard
+
+        def ring_step(step, carry):
+            acc, m, l, k_cur, v_cur = carry
+            # Which global shard does k_cur hold? It started at `idx` and has
+            # been passed backward `step` times: origin = (idx + step) % n.
+            origin = (idx + step) % n
+            k_off = origin * shard
+
+            def attend(args):
+                acc, m, l = args
+                a2, m2, l2 = _block_attend(q_blk, k_cur, v_cur, q_off, k_off,
+                                           causal, sm_scale)
+                acc, m, l = _merge(acc, m, l, a2, m2, l2)
+                return acc, m, l
+
+            # Causal: skip shards strictly in the future (k_off > q end).
+            if causal:
+                acc, m, l = jax.lax.cond(
+                    k_off > q_off + shard - 1, lambda a: a, attend, (acc, m, l))
+            else:
+                acc, m, l = attend((acc, m, l))
+            # Pass K/V to the previous neighbor (receive from next) so the
+            # ring sweeps forward through global shards.
+            perm = [(i, (i - 1) % n) for i in range(n)]
+            k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+            return acc, m, l, k_nxt, v_nxt
+
+        acc0 = jnp.zeros((B, shard, H, D), jnp.float32)
+        m0 = jnp.full((B, shard, H), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, shard, H), jnp.float32)
+        acc, m, l, _, _ = jax.lax.fori_loop(
+            0, n, ring_step, (acc0, m0, l0, k_blk, v_blk))
+        l = jnp.maximum(l, 1e-30)
+        return (acc / l[..., None]).astype(q_blk.dtype)
+
+    spec = P(None, axis_name, None, None)
+    out = jax.shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+    return out

@@ -1,0 +1,29 @@
+"""Packaging (parity: reference setup.py). Not needed for in-repo use."""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="maggy-tpu",
+    version="0.1.0",
+    description=(
+        "TPU-native asynchronous hyperparameter optimization, ablation "
+        "studies, and distributed training on JAX/XLA/Pallas."
+    ),
+    packages=find_packages(exclude=["tests", "examples"]),
+    package_data={"maggy_tpu.native": ["framing.cpp"]},
+    python_requires=">=3.10",
+    install_requires=[
+        "numpy",
+        "msgpack",
+        "jax",
+        "flax",
+        "optax",
+        "scipy",
+        "scikit-learn",
+    ],
+    extras_require={
+        "checkpoint": ["orbax-checkpoint"],
+        "tensorboard": ["torch", "tensorboard"],
+        "gcs": ["gcsfs"],
+    },
+)

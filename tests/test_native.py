@@ -1,0 +1,68 @@
+"""Native codec tests: C++ HMAC/frame-scan vs Python reference."""
+
+import hashlib
+import hmac
+import struct
+
+import pytest
+
+from maggy_tpu import native
+
+
+class TestNativeCodec:
+    def test_builds(self):
+        assert native.is_native(), "g++ build of framing.cpp failed"
+
+    def test_hmac_matches_python(self):
+        for key, msg in [
+            (b"k", b""),
+            (b"secret-key", b"hello world"),
+            (b"x" * 64, b"y" * 1000),
+            (b"long-key" * 20, b"payload"),  # key > 64 bytes -> hashed
+        ]:
+            expected = hmac.new(key, msg, hashlib.sha256).digest()
+            assert native.hmac_sha256(key, msg) == expected
+
+    def frame(self, payload: bytes, key: bytes) -> bytes:
+        mac = hmac.new(key, payload, hashlib.sha256).digest()
+        return struct.pack(">I", len(payload)) + mac + payload
+
+    def test_frame_scan_valid(self):
+        key = b"s3cret"
+        payload = b"\x81\xa4type\xa3REG"
+        buf = self.frame(payload, key)
+        consumed = native.frame_scan(buf, key, 1 << 20)
+        assert consumed == len(buf)
+
+    def test_frame_scan_incomplete(self):
+        key = b"k"
+        buf = self.frame(b"abcdef", key)
+        assert native.frame_scan(buf[:10], key, 1 << 20) == 0
+        assert native.frame_scan(buf[:-1], key, 1 << 20) == 0
+
+    def test_frame_scan_bad_mac(self):
+        key = b"k"
+        buf = bytearray(self.frame(b"abcdef", key))
+        buf[10] ^= 0xFF  # corrupt the mac
+        assert native.frame_scan(bytes(buf), key, 1 << 20) == -2
+
+    def test_frame_scan_oversized(self):
+        key = b"k"
+        buf = struct.pack(">I", 1 << 30) + b"\x00" * 32
+        assert native.frame_scan(buf, key, 1 << 20) == -1
+
+    def test_frame_scan_two_frames(self):
+        key = b"k"
+        b1 = self.frame(b"first", key)
+        b2 = self.frame(b"second", key)
+        consumed = native.frame_scan(b1 + b2, key, 1 << 20)
+        assert consumed == len(b1)
+        assert native.frame_scan((b1 + b2)[consumed:], key, 1 << 20) == len(b2)
+
+    def test_python_fallback_agrees(self, monkeypatch):
+        monkeypatch.setattr(native, "get_lib", lambda: None)
+        key = b"fallback"
+        buf = self.frame(b"payload!", key)
+        assert native.frame_scan(buf, key, 1 << 20) == len(buf)
+        assert native.hmac_sha256(key, b"m") == \
+            hmac.new(key, b"m", hashlib.sha256).digest()
