@@ -11,5 +11,7 @@ from maggy_tpu.models.mnist_cnn import MnistCNN
 from maggy_tpu.models.resnet import ResNet
 from maggy_tpu.models.bert import BertEncoder, BertConfig
 from maggy_tpu.models.llama import Llama, LlamaConfig
+from maggy_tpu.models.moe import MoEMLP
 
-__all__ = ["MnistCNN", "ResNet", "BertEncoder", "BertConfig", "Llama", "LlamaConfig"]
+__all__ = ["MnistCNN", "ResNet", "BertEncoder", "BertConfig", "Llama",
+           "LlamaConfig", "MoEMLP"]

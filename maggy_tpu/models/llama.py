@@ -52,6 +52,18 @@ class LlamaConfig:
     lora_rank: int = 0
     lora_alpha: float = 16.0
     remat: bool = True
+    # Sequence/context parallelism: attention_impl="ring" runs blockwise
+    # ring attention over ``seq_mesh``'s ``seq_axis`` (Q/K/V sharded on the
+    # sequence dim, K/V shards circulated via ppermute over ICI). "auto"
+    # dispatches to the Pallas flash kernel / XLA reference path.
+    attention_impl: str = "auto"
+    seq_axis: str = "seq"
+    seq_mesh: Any = None
+    # Mixture-of-experts: num_experts > 0 replaces the dense MLP with a
+    # top-k routed MoE MLP (experts sharded over the "expert" mesh axis).
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
 
     @staticmethod
     def tiny(vocab_size: int = 256, lora_rank: int = 0) -> "LlamaConfig":
@@ -156,7 +168,25 @@ class Attention(nn.Module):
         v = v.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        out = multi_head_attention(q, k, v, causal=True, mask=mask)
+        if cfg.attention_impl == "ring":
+            if cfg.seq_mesh is None:
+                raise ValueError(
+                    "attention_impl='ring' requires cfg.seq_mesh (a Mesh "
+                    "with a '{}' axis)".format(cfg.seq_axis))
+            if mask is not None:
+                raise ValueError(
+                    "attention_impl='ring' supports only causal masking; "
+                    "got an explicit mask")
+            from maggy_tpu.parallel.ring_attention import ring_attention
+
+            if cfg.num_kv_heads != cfg.num_heads:
+                rep = cfg.num_heads // cfg.num_kv_heads
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            out = ring_attention(q, k, v, cfg.seq_mesh,
+                                 axis_name=cfg.seq_axis, causal=True)
+        else:
+            out = multi_head_attention(q, k, v, causal=True, mask=mask)
         out = out.reshape(B, S, cfg.num_heads * cfg.head_dim)
         return dense(cfg.hidden_dim, (HEADS, EMBED), "o_proj")(out)
 
@@ -185,7 +215,18 @@ class DecoderLayer(nn.Module):
         h = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attn_norm")(x),
             positions, mask)
-        return h + MLP(cfg, name="mlp")(
+        if cfg.num_experts > 0:
+            from maggy_tpu.models.moe import MoEMLP
+
+            mlp = MoEMLP(
+                hidden_dim=cfg.hidden_dim,
+                intermediate_dim=cfg.intermediate_dim,
+                num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="moe_mlp")
+        else:
+            mlp = MLP(cfg, name="mlp")
+        return h + mlp(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="mlp_norm")(h))
 
 

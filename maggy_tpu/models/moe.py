@@ -1,0 +1,127 @@
+"""Mixture-of-experts MLP with expert parallelism over an "expert" mesh axis.
+
+Green-field TPU-first design (the reference has no model code, SURVEY.md
+§5.7; expert parallelism is listed absent in §2.8). GShard-style top-k
+routing with a static expert capacity so every shape is fixed under jit:
+
+- router logits -> top-k experts per token, position-in-expert via cumsum
+- dispatch/combine are ONE-HOT EINSUMS (dense [B,S,E,C] tensors), which XLA
+  maps onto the MXU and — when the stacked expert dim of the weights is
+  sharded over the "expert" mesh axis while tokens are sharded over "data" —
+  lowers the dispatch into an all-to-all over ICI. No gather/scatter, no
+  dynamic shapes, no sorting.
+- load-balancing auxiliary loss (Shazeer et al. 2017 / GShard eq. 4) is
+  sowed into the "losses" collection; train/trainer.py adds every sowed
+  "losses" leaf to the objective when aux collections are enabled.
+
+Weights are annotated with logical axis ("expert", embed, mlp) so
+parallel/sharding.logical_axis_rules("..._ep") maps them onto the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+EXPERT = "expert"
+
+
+def _top_k_mask(probs: jnp.ndarray, k: int) -> jnp.ndarray:
+    """[*, E] -> 0/1 mask of the k largest entries per row."""
+    top_vals = jax.lax.top_k(probs, k)[0]
+    thresh = top_vals[..., -1:]
+    return (probs >= thresh).astype(probs.dtype)
+
+
+def routing_tensors(
+    router_logits: jnp.ndarray, num_experts: int, capacity: int, top_k: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Compute (dispatch [B,S,E,C] 0/1, combine [B,S,E,C], aux_loss).
+
+    Tokens beyond an expert's capacity are dropped (their combine weight is
+    zero — the residual connection carries them through unchanged).
+    """
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    mask = _top_k_mask(probs, top_k)  # [B,S,E]
+    # Position of each token within each expert's buffer (tokens ordered by
+    # sequence position), counted over the flattened (B,S) token stream per
+    # batch row: capacity is per (batch row, expert).
+    pos = jnp.cumsum(mask, axis=1) * mask - 1.0  # [B,S,E], -1 where unrouted
+    keep = (pos >= 0) & (pos < capacity)
+    pos = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    onehot_pos = jax.nn.one_hot(pos, capacity, dtype=probs.dtype)  # [B,S,E,C]
+    dispatch = onehot_pos * keep.astype(probs.dtype)[..., None]
+    gates = probs * mask
+    # Renormalize kept gates so the combine weights of each token sum to ~1.
+    denom = jnp.sum(gates, axis=-1, keepdims=True)
+    gates = gates / jnp.maximum(denom, 1e-9)
+    combine = dispatch * gates[..., None]
+    # Load-balancing aux loss: E * sum_e f_e * p_e  (f = fraction of tokens
+    # routed to e, p = mean router prob of e). Minimized when uniform.
+    f = jnp.mean(mask, axis=(0, 1))
+    p = jnp.mean(probs, axis=(0, 1))
+    aux_loss = num_experts * jnp.sum(f * p)
+    return dispatch, combine, aux_loss
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed SwiGLU MLP with E stacked experts.
+
+    x: [B, S, D] -> [B, S, D]. Expert weights are stacked on a leading
+    expert dim with logical axis EXPERT, so under an "..._ep" strategy each
+    device holds |E|/|expert axis| experts and XLA inserts the token
+    all-to-all.
+    """
+
+    hidden_dim: int
+    intermediate_dim: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    aux_loss_weight: float = 0.01
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    embed_axis: str = "embed"
+    mlp_axis: str = "mlp"
+
+    @nn.compact
+    def __call__(self, x):
+        B, S, D = x.shape
+        E = self.num_experts
+        # A single-expert config degenerates to top-1 routing (top_k can't
+        # exceed the number of experts).
+        top_k = min(self.top_k, E)
+        capacity = max(1, int(self.capacity_factor * S * top_k / E))
+
+        router = self.param(
+            "router", nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), (self.embed_axis, EXPERT)),
+            (D, E), self.param_dtype)
+        logits = jnp.dot(x.astype(jnp.float32), router)  # [B,S,E]
+        dispatch, combine, aux = routing_tensors(logits, E, capacity, top_k)
+        self.sow("losses", "moe_aux_loss", self.aux_loss_weight * aux)
+
+        def expert_param(name, shape, axes):
+            return self.param(name, nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), (EXPERT,) + axes), shape,
+                self.param_dtype)
+
+        F = self.intermediate_dim
+        w_gate = expert_param("gate_proj", (E, D, F), (self.embed_axis, self.mlp_axis))
+        w_up = expert_param("up_proj", (E, D, F), (self.embed_axis, self.mlp_axis))
+        w_down = expert_param("down_proj", (E, F, D), (self.mlp_axis, self.embed_axis))
+
+        dispatch = dispatch.astype(self.dtype)
+        combine = combine.astype(self.dtype)
+        xd = x.astype(self.dtype)
+        # Dispatch: [B,S,E,C] x [B,S,D] -> [E,B,C,D] expert inputs.
+        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, xd)
+        gate = jnp.einsum("ebcd,edf->ebcf", expert_in, w_gate.astype(self.dtype))
+        up = jnp.einsum("ebcd,edf->ebcf", expert_in, w_up.astype(self.dtype))
+        act = nn.silu(gate) * up
+        expert_out = jnp.einsum("ebcf,efd->ebcd", act, w_down.astype(self.dtype))
+        # Combine back to token order, weighted by the (renormalized) gates.
+        return jnp.einsum("bsec,ebcd->bsd", combine, expert_out)

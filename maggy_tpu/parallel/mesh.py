@@ -11,6 +11,8 @@ Mesh axis conventions (scaling-book style):
 - "fsdp":   fully-sharded data parallelism (params sharded over data axis)
 - "model":  tensor parallelism (weights sharded within layers)
 - "seq":    sequence/context parallelism (ring attention)
+- "pipe":   pipeline parallelism (GPipe microbatching, parallel/pipeline.py)
+- "expert": expert parallelism (MoE token all-to-all, models/moe.py)
 """
 
 from __future__ import annotations

@@ -1,0 +1,176 @@
+"""Pipeline parallelism: GPipe-style microbatching over a "pipe" mesh axis.
+
+Parallelism the reference entirely lacks (SURVEY.md §2.8 lists PP absent).
+TPU-first SPMD design — instead of per-stage processes with P2P sends (the
+GPU/NCCL shape), every device runs the SAME program under `shard_map`:
+
+- the stacked stage dim of the layer params is sharded over "pipe", so each
+  device holds exactly its stage's weights (no weight broadcast);
+- a single activation "slot" per device circulates via `lax.ppermute`
+  (neighbor exchange over ICI) once per tick;
+- `lax.scan` over M + n - 1 ticks: stage 0 ingests microbatch t, stage n-1
+  emits microbatch t-(n-1); the scan is reverse-differentiable, so the
+  backward pipeline falls out of autodiff (ppermute transposes to the
+  reversed ring) — no hand-written 1F1B schedule needed;
+- all shapes are static; the bubble is the usual (n-1)/(M+n-1) fraction.
+
+`pipeline_apply` is the generic schedule; `PipelinedLM` is a small
+functional decoder (embed -> pipelined residual blocks -> head) used by the
+multi-chip dry run and tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x: jnp.ndarray,
+    mesh,
+    axis: str = "pipe",
+    num_microbatches: Optional[int] = None,
+):
+    """Run ``x`` through ``n = mesh.shape[axis]`` pipeline stages.
+
+    stage_fn(params, act) -> act: one stage's compute; must preserve the
+        activation's shape/dtype (residual-block style).
+    stage_params: pytree whose leaves are stacked [n, ...] on dim 0 (stage i
+        uses leaf[i]); shard them with `stage_param_sharding`.
+    x: [B, ...] global batch; B must divide into ``num_microbatches``
+        (default n) equal microbatches.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    M = int(num_microbatches or n)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(
+            "Batch {} must divide into {} microbatches".format(B, M))
+    x_mb = x.reshape((M, B // M) + x.shape[1:])
+
+    def local_fn(params_local, x_mb):
+        idx = jax.lax.axis_index(axis)
+        # shard_map hands each device a [1, ...] slice of the stacked stage
+        # dim; drop it to get this stage's params.
+        params = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        state0 = jnp.zeros_like(x_mb[0])
+
+        def tick(state, t):
+            inp = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+            state = jnp.where(idx == 0, inp, state)
+            out = stage_fn(params, state)
+            # Rotate forward one stage per tick (ICI neighbor exchange);
+            # stage n-1 -> 0 wraps but is overwritten by fresh input.
+            nxt = jax.lax.ppermute(
+                out, axis, [(i, (i + 1) % n) for i in range(n)])
+            return nxt, out
+
+        _, emits = jax.lax.scan(tick, state0, jnp.arange(M + n - 1))
+        # On the last stage, microbatch m leaves the pipe at tick m + n - 1.
+        y_local = emits[n - 1:]
+        # Broadcast the last stage's outputs to every device (replicated
+        # result lets the unsharded head/loss follow under plain GSPMD).
+        return jax.lax.psum(
+            jnp.where(idx == n - 1, y_local, jnp.zeros_like(y_local)), axis)
+
+    stage_spec = jax.tree_util.tree_map(
+        lambda p: P(axis, *([None] * (np.ndim(p) - 1))), stage_params)
+    out = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(stage_spec, P()), out_specs=P(),
+        check_vma=False,
+    )(stage_params, x_mb)
+    return out.reshape((B,) + out.shape[2:])
+
+
+def stage_param_sharding(mesh, stage_params, axis: str = "pipe"):
+    """NamedShardings placing each leaf's stacked stage dim on ``axis``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.tree_util.tree_map(
+        lambda p: NamedSharding(mesh, P(axis, *([None] * (np.ndim(p) - 1)))),
+        stage_params)
+
+
+class PipelinedLM:
+    """Minimal functional decoder for the pp path: embedding -> n_stages of
+    residual SwiGLU blocks (stacked + pipelined) -> head.
+
+    Pure functions over an explicit param pytree (no flax) so the stacked
+    stage dim is first-class; init places params directly into their
+    shardings when a mesh is given.
+    """
+
+    def __init__(self, vocab_size: int, hidden_dim: int, intermediate_dim: int,
+                 num_stages: int, layers_per_stage: int = 1,
+                 dtype: Any = jnp.bfloat16):
+        self.vocab_size = vocab_size
+        self.hidden_dim = hidden_dim
+        self.intermediate_dim = intermediate_dim
+        self.num_stages = num_stages
+        self.layers_per_stage = layers_per_stage
+        self.dtype = dtype
+
+    def init(self, rng, mesh=None, axis: str = "pipe"):
+        V, D, F = self.vocab_size, self.hidden_dim, self.intermediate_dim
+        n, L = self.num_stages, self.layers_per_stage
+        ks = jax.random.split(rng, 5)
+        scale = lambda fan_in: 1.0 / np.sqrt(fan_in)  # noqa: E731
+        params = {
+            "embed": jax.random.normal(ks[0], (V, D), jnp.float32) * 0.02,
+            "stages": {
+                "w_gate": jax.random.normal(ks[1], (n, L, D, F)) * scale(D),
+                "w_up": jax.random.normal(ks[2], (n, L, D, F)) * scale(D),
+                "w_down": jax.random.normal(ks[3], (n, L, F, D)) * scale(F),
+            },
+            "head": jax.random.normal(ks[4], (D, V), jnp.float32) * 0.02,
+        }
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            shardings = {
+                "embed": NamedSharding(mesh, P()),
+                "stages": stage_param_sharding(mesh, params["stages"], axis),
+                "head": NamedSharding(mesh, P()),
+            }
+            params = jax.tree_util.tree_map(jax.device_put, params, shardings)
+        return params
+
+    def stage_fn(self, stage_params, x):
+        """L residual SwiGLU blocks; [mb, S, D] -> [mb, S, D]."""
+
+        def block(x, layer):
+            w_gate, w_up, w_down = layer
+            h = jnp.dot(x, w_gate.astype(self.dtype))
+            u = jnp.dot(x, w_up.astype(self.dtype))
+            y = jnp.dot(jax.nn.silu(h) * u, w_down.astype(self.dtype))
+            return x + y, None
+
+        layers = (stage_params["w_gate"], stage_params["w_up"],
+                  stage_params["w_down"])
+        x, _ = jax.lax.scan(block, x, layers)
+        return x
+
+    def apply(self, params, tokens, mesh, axis: str = "pipe",
+              num_microbatches: Optional[int] = None):
+        x = params["embed"].astype(self.dtype)[tokens]
+        x = pipeline_apply(
+            lambda p, a: self.stage_fn(p, a), params["stages"], x, mesh,
+            axis=axis, num_microbatches=num_microbatches)
+        return jnp.dot(x, params["head"].astype(self.dtype)).astype(jnp.float32)
+
+    def apply_sequential(self, params, tokens):
+        """Reference forward with NO pipelining (correctness oracle)."""
+        x = params["embed"].astype(self.dtype)[tokens]
+        for i in range(self.num_stages):
+            stage = jax.tree_util.tree_map(lambda p: p[i], params["stages"])
+            x = self.stage_fn(stage, x)
+        return jnp.dot(x, params["head"].astype(self.dtype)).astype(jnp.float32)

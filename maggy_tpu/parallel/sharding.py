@@ -82,33 +82,55 @@ def shard_params(mesh, params, strategy: str = "dp"):
 
 def logical_axis_rules(strategy: str = "dp"):
     """Logical-axis -> mesh-axis rules for the model zoo's
-    `nn.with_logical_partitioning` annotations (llama.py/bert.py).
+    `nn.with_logical_partitioning` annotations (llama.py/bert.py/moe.py).
 
-    - dp:    everything replicated
-    - fsdp:  embed dim sharded over "fsdp" (ZeRO-3)
-    - tp:    head/mlp/vocab dims sharded over "model" (Megatron)
-    - fsdp_tp: both
+    ``strategy`` is underscore-composable from {"dp", "fsdp", "tp", "sp",
+    "ep"} — e.g. "dp", "fsdp_tp", "dp_sp", "dp_sp_ep":
+
+    - dp:   everything replicated (gradients all-reduced over "data")
+    - fsdp: embed dim sharded over "fsdp" (ZeRO-3)
+    - tp:   head/mlp/vocab dims sharded over "model" (Megatron)
+    - sp:   no param sharding; activations' sequence dim shards via
+            batch_sharding + ring attention over "seq"
+    - ep:   MoE expert dim sharded over "expert"
     """
-    if strategy == "dp":
-        return [("embed", None), ("mlp", None), ("heads", None),
-                ("kv", None), ("vocab", None)]
-    if strategy == "fsdp":
-        return [("embed", "fsdp"), ("mlp", None), ("heads", None),
-                ("kv", None), ("vocab", None)]
-    if strategy == "tp":
-        return [("embed", None), ("mlp", "model"), ("heads", "model"),
-                ("kv", "model"), ("vocab", "model")]
-    if strategy == "fsdp_tp":
-        return [("embed", "fsdp"), ("mlp", "model"), ("heads", "model"),
-                ("kv", "model"), ("vocab", "model")]
-    raise ValueError("Unknown strategy {!r}".format(strategy))
+    rules = {"embed": None, "mlp": None, "heads": None, "kv": None,
+             "vocab": None, "expert": None}
+    parts = set(strategy.split("_"))
+    unknown = parts - {"dp", "fsdp", "tp", "sp", "ep"}
+    if unknown:
+        raise ValueError("Unknown strategy {!r} (bad parts: {})"
+                         .format(strategy, sorted(unknown)))
+    if "fsdp" in parts:
+        rules["embed"] = "fsdp"
+    if "tp" in parts:
+        rules.update(mlp="model", heads="model", kv="model", vocab="model")
+    if "ep" in parts:
+        # Expert-parallel: the stacked expert dim of MoE weights shards over
+        # the "expert" mesh axis; token dispatch becomes an XLA all-to-all.
+        rules["expert"] = "expert"
+    # "dp" and "sp" add no param sharding (sp shards activations' sequence
+    # dim via batch_sharding + ring attention, params stay as above).
+    return list(rules.items())
 
 
-def batch_sharding(mesh, ndim: int = 2):
-    """Batch sharded over every data-like axis on dim 0, replicated after."""
+def batch_sharding(mesh, ndim: int = 2, shape=None):
+    """Batch sharded over every data-like axis on dim 0, replicated after.
+
+    If the mesh has a "seq" axis (sequence/context parallelism), dim 1 — the
+    sequence dim of [B, S, ...] batches — is sharded over it, matching the
+    ring-attention layout (parallel/ring_attention.py). When ``shape`` is
+    given, the seq rule applies only if dim 1 divides evenly (non-sequence
+    tensors like [B, features] stay replicated past dim 0).
+    """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if shape is not None:
+        ndim = len(shape)
     data_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
-    return NamedSharding(mesh, P(data_axes if data_axes else None,
-                                 *([None] * (ndim - 1))))
+    rest = [None] * (ndim - 1)
+    if ndim >= 2 and "seq" in mesh.axis_names and (
+            shape is None or shape[1] % mesh.shape["seq"] == 0):
+        rest[0] = "seq"
+    return NamedSharding(mesh, P(data_axes if data_axes else None, *rest))

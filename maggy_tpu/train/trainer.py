@@ -71,7 +71,10 @@ def init_train_state(
     init_kwargs = init_kwargs or {}
 
     def init_fn(rng):
-        return model.init(rng, *example_inputs, **init_kwargs)
+        variables = model.init(rng, *example_inputs, **init_kwargs)
+        # "losses" holds per-apply sowed scalars (e.g. MoE aux loss) — it is
+        # recomputed every step, not trained state.
+        return {k: v for k, v in variables.items() if k != "losses"}
 
     abstract = jax.eval_shape(init_fn, rng)
     _, shardings = _unbox_and_specs(abstract, mesh, strategy)
@@ -110,13 +113,15 @@ def make_train_step(
 
         def compute_loss(p):
             vs = {"params": p, **aux}
-            if has_aux_collections:
-                out, updates = model.apply(
-                    vs, *batch["inputs"], mutable=list(aux.keys()),
-                    **train_kwargs)
-                return loss_fn(out, batch), updates
-            out = model.apply(vs, *batch["inputs"], **train_kwargs)
-            return loss_fn(out, batch), {}
+            mutable = (list(aux.keys()) if has_aux_collections else []) + ["losses"]
+            out, updates = model.apply(
+                vs, *batch["inputs"], mutable=mutable, **train_kwargs)
+            loss = loss_fn(out, batch)
+            # Sowed auxiliary losses (MoE load balancing etc.) join the
+            # objective; they are scalars, summed over all sow sites.
+            for leaf in jax.tree_util.tree_leaves(updates.pop("losses", {})):
+                loss = loss + jnp.sum(leaf)
+            return loss, updates
 
         (loss, new_aux), grads = jax.value_and_grad(
             compute_loss, has_aux=True)(params)
@@ -163,7 +168,7 @@ class Trainer:
 
     def place_batch(self, batch: Dict[str, Any]):
         def put(x):
-            sh = batch_sharding(self.mesh, np.ndim(x))
+            sh = batch_sharding(self.mesh, shape=np.shape(x))
             return jax.device_put(jnp.asarray(x), sh)
 
         return jax.tree_util.tree_map(put, batch)
