@@ -18,6 +18,7 @@ from maggy_tpu.config import (
     AblationConfig,
     DistributedConfig,
 )
+from maggy_tpu.core.executors.context import TrialContext
 
 __all__ = [
     "Searchspace",
@@ -26,4 +27,5 @@ __all__ = [
     "OptimizationConfig",
     "AblationConfig",
     "DistributedConfig",
+    "TrialContext",
 ]

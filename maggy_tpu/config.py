@@ -52,6 +52,8 @@ class OptimizationConfig(LagomConfig):
     # Per-trial device assignment: how many TPU chips each trial gets
     # (used by pool="tpu").
     chips_per_trial: int = 1
+    # Capture a jax.profiler trace per trial into its TensorBoard dir.
+    profile: bool = False
     # Experiment artifact root; defaults to the environment's base dir.
     experiment_dir: Optional[str] = None
 

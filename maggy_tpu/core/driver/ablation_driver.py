@@ -53,6 +53,7 @@ class AblationDriver(OptimizationDriver):
             train_fn=train_fn,
             trial_type="ablation",
             ablation_resolver=self.controller.make_resolver(),
+            profile=getattr(self.config, "profile", False),
         )
 
     def _exp_startup_callback(self) -> None:

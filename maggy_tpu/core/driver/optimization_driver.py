@@ -142,6 +142,7 @@ class OptimizationDriver(Driver):
             optimization_key=self.optimization_key,
             train_fn=train_fn,
             trial_type="optimization",
+            profile=getattr(self.config, "profile", False),
         )
 
     def secret_for_clients(self) -> str:

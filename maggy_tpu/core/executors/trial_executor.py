@@ -19,8 +19,12 @@ from __future__ import annotations
 
 import inspect
 import os
+import threading
 import traceback
 from typing import Callable, Optional, Tuple
+
+# The JAX profiler allows one active trace per process.
+_PROFILE_LOCK = threading.Lock()
 
 from maggy_tpu import util
 from maggy_tpu.core.environment import EnvSing
@@ -43,6 +47,7 @@ class TrialExecutor:
         train_fn: Callable,
         trial_type: str = "optimization",
         ablation_resolver: Optional[Callable] = None,
+        profile: bool = False,
     ):
         self.server_addr = server_addr
         self.secret = secret
@@ -52,6 +57,7 @@ class TrialExecutor:
         self.train_fn = train_fn
         self.trial_type = trial_type
         self.ablation_resolver = ablation_resolver
+        self.profile = profile
 
     def __call__(self, partition_id: int) -> None:
         env = EnvSing.get_instance()
@@ -65,7 +71,9 @@ class TrialExecutor:
         try:
             client.register()
             client.start_heartbeat(reporter)
-            wants_reporter = "reporter" in inspect.signature(self.train_fn).parameters
+            sig_params = inspect.signature(self.train_fn).parameters
+            wants_reporter = "reporter" in sig_params
+            wants_ctx = "ctx" in sig_params
 
             while not client.done:
                 trial_id, params = client.get_suggestion()
@@ -91,10 +99,17 @@ class TrialExecutor:
                     # (replaces the reference's pickled callables,
                     # `loco.py:224-259`; SURVEY.md §7 hard part 3).
                     call_params = self.ablation_resolver(call_params)
+                ctx = None
                 try:
                     if wants_reporter:
                         call_params["reporter"] = reporter
-                    retval = self.train_fn(**call_params)
+                    if wants_ctx:
+                        from maggy_tpu.core.executors.context import TrialContext
+
+                        ctx = TrialContext(trial_id, trial_dir, exp_dir,
+                                           params, client.last_info)
+                        call_params["ctx"] = ctx
+                    retval = self._run_trial(call_params, trial_dir)
                     metric = util.handle_return_val(
                         retval, trial_dir, self.optimization_key, env
                     )
@@ -116,6 +131,9 @@ class TrialExecutor:
                              "error": True, "logs": reporter.get_data()["logs"]}
                         )
                         reporter.reset()
+                finally:
+                    if ctx is not None:
+                        ctx.close()
         finally:
             try:
                 # Flush the last trial's TensorBoard events (torch's writer
@@ -127,6 +145,29 @@ class TrialExecutor:
             except Exception:  # noqa: BLE001
                 pass
             client.stop()
+
+
+    def _run_trial(self, call_params: dict, trial_dir: str):
+        """Invoke the user train_fn, optionally under a `jax.profiler`
+        trace (SURVEY.md §5.1: the TPU-idiomatic stand-in for the
+        reference's absent profiling — traces land in the trial's
+        TensorBoard dir and open in its profile plugin).
+
+        The JAX profiler is process-global (one trace at a time), so with
+        an in-process thread pool tracing is best-effort: a trial whose
+        start overlaps an already-traced trial runs untraced. Process/TPU
+        pools have one trial per process and trace every trial."""
+        if not self.profile:
+            return self.train_fn(**call_params)
+        if not _PROFILE_LOCK.acquire(blocking=False):
+            return self.train_fn(**call_params)
+        try:
+            import jax
+
+            with jax.profiler.trace(os.path.join(trial_dir, "tensorboard")):
+                return self.train_fn(**call_params)
+        finally:
+            _PROFILE_LOCK.release()
 
 
 def trial_executor_fn(**kwargs) -> TrialExecutor:

@@ -362,7 +362,8 @@ class OptimizationServer(Server):
             return {"type": "OK", "trial_id": None}
         trial.set_status(Trial.RUNNING)
         trial.start = time.time()
-        return {"type": "TRIAL", "trial_id": trial.trial_id, "params": trial.params}
+        return {"type": "TRIAL", "trial_id": trial.trial_id,
+                "params": trial.params, "info": dict(trial.info_dict)}
 
     def _log(self, msg):
         return {"type": "LOG", **self.driver.progress_snapshot()}
@@ -450,6 +451,7 @@ class Client:
         self.hb_interval = hb_interval
         self.secret = secret.encode() if isinstance(secret, str) else secret
         self.done = False
+        self.last_info: dict = {}
         self._sock = self._connect()
         self._hb_sock = self._connect()
         self._hb_thread: Optional[threading.Thread] = None
@@ -533,6 +535,9 @@ class Client:
                 self.done = True
                 return None, None
             if rtype == "TRIAL":
+                # Scheduler metadata (budget, promoted-trial parent, sample
+                # type) rides along for TrialContext consumers.
+                self.last_info = resp.get("info", {})
                 return resp["trial_id"], resp["params"]
             if deadline and time.monotonic() > deadline:
                 return None, None

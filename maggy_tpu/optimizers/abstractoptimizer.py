@@ -158,6 +158,7 @@ class AbstractOptimizer(ABC):
         sample_type: str = "random",
         run_budget: float = 0,
         model_budget: Optional[float] = None,
+        parent: Optional[str] = None,
     ) -> Trial:
         """Build a Trial with provenance info (reference
         `abstractoptimizer.py:317-376`): info_dict carries run_budget,
@@ -171,6 +172,10 @@ class AbstractOptimizer(ABC):
         }
         if model_budget is not None:
             info["model_budget"] = model_budget
+        if parent is not None:
+            # Promoted-trial lineage: lets the executor warm-start from the
+            # parent's checkpoint (TrialContext.restore_parent).
+            info["parent"] = parent
         params = dict(hparams)
         if self.pruner is not None and run_budget:
             params["budget"] = run_budget

@@ -86,7 +86,8 @@ class BaseAsyncBO(AbstractOptimizer):
             if parent_id is not None:
                 # Promotion: re-run parent's config at the new budget.
                 params = self._strip_budget(self._lookup_params(parent_id))
-                new_trial = self.create_trial(params, sample_type="promoted", run_budget=budget)
+                new_trial = self.create_trial(params, sample_type="promoted",
+                                              run_budget=budget, parent=parent_id)
                 self.pruner.report_trial(parent_id, new_trial.trial_id)
                 return new_trial
 

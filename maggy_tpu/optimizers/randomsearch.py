@@ -56,7 +56,8 @@ class RandomSearch(AbstractOptimizer):
             # promoted config re-run at a bigger budget
             parent_params = self._lookup_params(parent_id)
             params = self._strip_budget(parent_params)
-            new_trial = self.create_trial(params, sample_type="promoted", run_budget=budget)
+            new_trial = self.create_trial(params, sample_type="promoted",
+                                          run_budget=budget, parent=parent_id)
         self.pruner.report_trial(original_trial_id=parent_id, new_trial_id=new_trial.trial_id)
         return new_trial
 

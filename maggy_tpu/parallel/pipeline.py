@@ -83,9 +83,19 @@ def pipeline_apply(
 
     stage_spec = jax.tree_util.tree_map(
         lambda p: P(axis, *([None] * (np.ndim(p) - 1))), stage_params)
+    # Shard the per-microbatch batch dim over any data axes so those axes do
+    # real data parallelism instead of replicated identical stage compute
+    # (the pipeline is batch-elementwise, so each data shard pipelines its
+    # own slice independently).
+    data_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    dp = int(np.prod([mesh.shape[a] for a in data_axes])) if data_axes else 1
+    if data_axes and (B // M) % dp == 0:
+        x_spec = P(None, data_axes, *([None] * (x_mb.ndim - 2)))
+    else:
+        x_spec = P()
     out = jax.shard_map(
         local_fn, mesh=mesh,
-        in_specs=(stage_spec, P()), out_specs=P(),
+        in_specs=(stage_spec, x_spec), out_specs=x_spec,
         check_vma=False,
     )(stage_params, x_mb)
     return out.reshape((B,) + out.shape[2:])

@@ -10,7 +10,14 @@ continues training at the bigger budget, which is a direct trials/hour win.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Optional
+
+# orbax/tensorstore checkpoint I/O is not thread-safe within one process
+# (async finalization renames race); thread-pooled trial runners share a
+# process, so serialize all checkpoint ops. Trials spend ~all their time
+# training, not checkpointing, so contention is negligible.
+_CKPT_LOCK = threading.Lock()
 
 
 class TrialCheckpointer:
@@ -18,32 +25,38 @@ class TrialCheckpointer:
         import orbax.checkpoint as ocp
 
         self.path = os.path.abspath(os.path.join(trial_dir, "checkpoints"))
-        self.manager = ocp.CheckpointManager(
-            self.path,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
-        )
+        with _CKPT_LOCK:
+            self.manager = ocp.CheckpointManager(
+                self.path,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=max_to_keep, enable_async_checkpointing=False),
+            )
 
-    def save(self, step: int, state: Any, wait: bool = True) -> None:
+    def save(self, step: int, state: Any) -> None:
+        """Synchronous save (async checkpointing is disabled above, so the
+        write has fully landed when this returns)."""
         import orbax.checkpoint as ocp
 
-        self.manager.save(step, args=ocp.args.StandardSave(state))
-        if wait:
-            self.manager.wait_until_finished()
+        with _CKPT_LOCK:
+            self.manager.save(step, args=ocp.args.StandardSave(state))
 
     def latest_step(self) -> Optional[int]:
-        return self.manager.latest_step()
+        with _CKPT_LOCK:
+            return self.manager.latest_step()
 
     def restore(self, abstract_state: Any, step: Optional[int] = None) -> Any:
         import orbax.checkpoint as ocp
 
-        step = step if step is not None else self.manager.latest_step()
-        if step is None:
-            return None
-        return self.manager.restore(
-            step, args=ocp.args.StandardRestore(abstract_state))
+        with _CKPT_LOCK:
+            step = step if step is not None else self.manager.latest_step()
+            if step is None:
+                return None
+            return self.manager.restore(
+                step, args=ocp.args.StandardRestore(abstract_state))
 
     def close(self) -> None:
-        self.manager.close()
+        with _CKPT_LOCK:
+            self.manager.close()
 
 
 def restore_parent_state(exp_dir: str, parent_trial_id: str,

@@ -79,7 +79,9 @@ class ShardedBatchIterator:
 
         from maggy_tpu.parallel.sharding import batch_sharding
 
-        return {k: jax.device_put(v, batch_sharding(self.mesh, v.ndim))
+        # shape= lets the seq-axis rule skip tensors whose dim 1 isn't a
+        # sequence dim (e.g. [B, features] labels on a seq-parallel mesh).
+        return {k: jax.device_put(v, batch_sharding(self.mesh, shape=v.shape))
                 for k, v in batch.items()}
 
     def __len__(self) -> int:
