@@ -47,21 +47,31 @@ class OptimizationConfig(LagomConfig):
     num_workers: int = 1
     seed: Optional[int] = None
     # Runner substrate: "thread" (in-process), "process" (one JAX runtime
-    # per trial), "tpu" (processes pinned to disjoint chip sub-slices).
+    # per trial), "tpu" (processes pinned to disjoint chip sub-slices),
+    # "remote" (external `python -m maggy_tpu.runner` agents join over DCN).
     pool: str = "thread"
+    # Control-plane bind host. Defaults to loopback for local pools; set to
+    # "0.0.0.0" (the default when pool="remote") to accept remote agents.
+    bind_host: Optional[str] = None
     # Per-trial device assignment: how many TPU chips each trial gets
     # (used by pool="tpu").
     chips_per_trial: int = 1
     # Capture a jax.profiler trace per trial into its TensorBoard dir.
     profile: bool = False
+    # Declare a runner lost after this many seconds of heartbeat silence
+    # while holding a trial (its trial is requeued to another runner).
+    # None -> max(HEARTBEAT_LOSS_MIN_S, hb_interval * HEARTBEAT_LOSS_FACTOR).
+    hb_loss_timeout: Optional[float] = None
     # Experiment artifact root; defaults to the environment's base dir.
     experiment_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.direction not in ("max", "min"):
             raise ValueError("direction must be 'max' or 'min', got {!r}".format(self.direction))
-        if self.pool not in ("thread", "process", "tpu"):
-            raise ValueError("pool must be 'thread', 'process', or 'tpu'")
+        if self.pool not in ("thread", "process", "tpu", "remote"):
+            raise ValueError("pool must be 'thread', 'process', 'tpu', or 'remote'")
+        if self.bind_host is None and self.pool == "remote":
+            self.bind_host = "0.0.0.0"
 
 
 @dataclass

@@ -25,6 +25,13 @@ RENDEZVOUS_TIMEOUT_S = 60.0
 CLIENT_MAX_RETRIES = 3
 RPC_RECV_BUFSIZE = 1 << 16
 
+# Failure detection: a runner whose assigned trial has gone this many
+# heartbeat intervals without any message is declared lost and its trial is
+# requeued to another runner (floor guards against sub-second hb_interval
+# settings declaring a compiling trial dead).
+HEARTBEAT_LOSS_FACTOR = 30.0
+HEARTBEAT_LOSS_MIN_S = 10.0
+
 # Early-stop defaults (reference `maggy/experiment_config.py:33-35`).
 DEFAULT_ES_INTERVAL = 1
 DEFAULT_ES_MIN = 10

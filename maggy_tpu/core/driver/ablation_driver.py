@@ -20,6 +20,12 @@ ABLATOR_REGISTRY = {"loco": LOCO}
 
 class AblationDriver(OptimizationDriver):
     def __init__(self, config: AblationConfig, app_id: str, run_id: int):
+        if getattr(config, "pool", "thread") == "remote":
+            raise ValueError(
+                "pool='remote' is not supported for ablation studies: the "
+                "study's model/dataset generators are local callables and "
+                "cannot be shipped to remote agents. Use a local pool."
+            )
         super().__init__(config, app_id, run_id)
         # Early stopping is meaningless for a fixed ablation schedule
         # (reference `ablation_driver.py:33`).

@@ -98,13 +98,25 @@ class Driver(ABC):
             pool = self._make_runner_pool()
             # Fan out the executor wrapper to all runners; BLOCKS until all
             # workers return (the reference's foreachPartition semantics).
-            pool.run(self._executor_fn(train_fn))
+            failures = pool.run(self._executor_fn(train_fn)) or []
             job_end = time.time()
             # A worker-callback failure must surface BEFORE finalization, or
             # the experiment would transiently be marked FINISHED with a
             # bogus result.json.
             if self.exception is not None:
                 raise self.exception
+            # Dead runners are survivable IF the surviving ones completed the
+            # schedule (their trials were requeued via heartbeat-loss
+            # detection); otherwise the failure is fatal.
+            if failures:
+                if self.experiment_done:
+                    self._log("{} runner(s) died but the experiment completed: "
+                              "{}".format(len(failures), failures))
+                else:
+                    raise RuntimeError(
+                        "{} runner(s) failed and the experiment did not "
+                        "complete: {}".format(len(failures), failures)
+                    ) from failures[0]
             result = self._exp_final_callback(job_end, {})
             return result
         except BaseException as exc:  # noqa: BLE001 - driver must always clean up
@@ -113,7 +125,8 @@ class Driver(ABC):
             self.stop()
 
     def init(self) -> None:
-        self.server_addr = self.env.connect_host(self.server)
+        self.server_addr = self.env.connect_host(
+            self.server, host=getattr(self.config, "bind_host", None))
         self._start_worker()
 
     def _start_worker(self) -> None:

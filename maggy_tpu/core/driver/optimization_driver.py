@@ -15,7 +15,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from maggy_tpu import util
+from maggy_tpu import constants, util
 from maggy_tpu.config import OptimizationConfig
 from maggy_tpu.core.driver.driver import Driver
 from maggy_tpu.core.executors.trial_executor import trial_executor_fn
@@ -55,6 +55,15 @@ class OptimizationDriver(Driver):
         self._trial_store: Dict[str, Trial] = {}
         self._final_store: List[Trial] = []
         self._store_lock = threading.RLock()
+        # Trials orphaned by a lost runner, waiting for reassignment. Served
+        # by _assign_next ahead of fresh controller suggestions.
+        self._requeue: List[str] = []
+        # Arm heartbeat-loss detection (SURVEY.md §5.3): a silent runner's
+        # trial is requeued to whichever runner asks for work next.
+        self.server.hb_loss_timeout = getattr(config, "hb_loss_timeout", None) or max(
+            constants.HEARTBEAT_LOSS_MIN_S,
+            self.hb_interval * constants.HEARTBEAT_LOSS_FACTOR,
+        )
         self.earlystop_check = self._init_earlystop(config)
         self.es_interval = config.es_interval
         self.es_min = config.es_min
@@ -131,6 +140,18 @@ class OptimizationDriver(Driver):
         if pool == "tpu":
             return TPURunnerPool(self.num_executors,
                                  chips_per_trial=self.config.chips_per_trial)
+        if pool == "remote":
+            from maggy_tpu.core.runner_pool import RemoteRunnerPool
+
+            # Open JOIN admission: agents that dial in get a partition id
+            # and this executor config.
+            self.server.join_info = {
+                "hb_interval": self.hb_interval,
+                "exp_dir": self.exp_dir,
+                "optimization_key": self.optimization_key,
+                "trial_type": "optimization",
+            }
+            return RemoteRunnerPool(self)
         raise ValueError("Unknown pool type {!r}".format(pool))
 
     def _executor_fn(self, train_fn):
@@ -157,6 +178,7 @@ class OptimizationDriver(Driver):
             FINAL=self._final_msg_callback,
             IDLE=self._idle_msg_callback,
             REG=self._register_msg_callback,
+            LOST=self._lost_msg_callback,
         )
 
     def get_trial(self, trial_id):
@@ -188,10 +210,34 @@ class OptimizationDriver(Driver):
         :363-367 + `rpc.py:308-326`)."""
         trial = self.get_trial(msg["trial_id"])
         if trial is not None:
-            trial.set_status(Trial.SCHEDULED)
+            trial.reset_run_state()
             self.server.reservations.assign_trial(msg["partition_id"], trial.trial_id)
             self._log("executor {} restarted; trial {} requeued".format(
                 msg["partition_id"], msg["trial_id"]))
+
+    def _lost_msg_callback(self, msg) -> None:
+        """A runner's heartbeats went silent while holding a trial: the
+        runner is presumed dead and the trial goes back into the schedule
+        for whichever runner asks for work next (elastic recovery beyond
+        the reference's same-executor blacklist, SURVEY.md §5.3)."""
+        trial = self.get_trial(msg["trial_id"])
+        if trial is None:
+            return
+        trial.reset_run_state()
+        with self._store_lock:
+            if trial.trial_id not in self._requeue:
+                self._requeue.append(trial.trial_id)
+        self.result["lost_runners"] = self.result.get("lost_runners", 0) + 1
+        self._log("runner {} heartbeat lost; trial {} requeued for reassignment".format(
+            msg["partition_id"], msg["trial_id"]))
+
+    def _pop_requeue(self) -> Optional[Trial]:
+        with self._store_lock:
+            while self._requeue:
+                trial = self._trial_store.get(self._requeue.pop(0))
+                if trial is not None:
+                    return trial
+        return None
 
     def _final_msg_callback(self, msg) -> None:
         """Finalize trial, persist artifacts, hand the executor new work
@@ -199,6 +245,11 @@ class OptimizationDriver(Driver):
         self.add_executor_logs(msg.get("logs"))
         trial = self.get_trial(msg.get("trial_id"))
         if trial is None:
+            # Duplicate FINAL (e.g. a falsely-declared-lost runner finishing a
+            # trial another runner re-ran). The result is already recorded,
+            # but the reporting runner still needs its next assignment or it
+            # would poll GET empty-handed forever.
+            self._assign_next(msg["partition_id"], None)
             return
         with trial.lock:
             if msg.get("error"):
@@ -233,10 +284,31 @@ class OptimizationDriver(Driver):
         # legitimately run more trials than `num_trials` rung-0 samples.
         if self.experiment_done:
             return
-        suggestion = self.controller.get_suggestion(last_trial)
+        # Orphaned trials (lost runners) take priority over fresh
+        # suggestions — but never swallow a FINAL report: when last_trial is
+        # set the controller must see it (ASHA rung bookkeeping, pruner
+        # reports) before any reassignment happens.
+        suggestion = "IDLE" if last_trial is None \
+            else self.controller.get_suggestion(last_trial)
+        if suggestion in (None, "IDLE"):
+            requeued = self._pop_requeue()
+            if requeued is not None:
+                self.server.reservations.assign_trial(partition_id, requeued.trial_id)
+                return
+            if last_trial is None:
+                suggestion = self.controller.get_suggestion(None)
         if suggestion is None:
-            self.experiment_done = True
-        elif suggestion == "IDLE":
+            # The controller has no more work — but the experiment is only
+            # over once nothing is in flight: a trial held by a (possibly
+            # dying) runner may yet come back through LOST and need this
+            # runner to pick it up.
+            with self._store_lock:
+                in_flight = bool(self._trial_store)
+            if in_flight:
+                suggestion = "IDLE"
+            else:
+                self.experiment_done = True
+        if suggestion == "IDLE":
             # Requeue after the idle tick from a timer, NOT by sleeping on the
             # single worker thread (64 idle runners would stall METRIC/FINAL
             # processing by ~0.6 s per cycle otherwise).
@@ -244,7 +316,7 @@ class OptimizationDriver(Driver):
             timer = threading.Timer(0.1, self.enqueue, args=(msg,))
             timer.daemon = True
             timer.start()
-        else:
+        elif suggestion is not None:
             with self._store_lock:
                 self._trial_store[suggestion.trial_id] = suggestion
             suggestion.set_status(Trial.SCHEDULED)

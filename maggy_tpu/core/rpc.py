@@ -100,7 +100,28 @@ class Reservations:
 
     def add(self, meta: Dict[str, Any]) -> None:
         with self.lock:
-            self._table[int(meta["partition_id"])] = dict(meta)
+            rec = dict(meta)
+            rec["last_beat"] = time.monotonic()
+            self._table[int(meta["partition_id"])] = rec
+
+    def touch(self, partition_id) -> None:
+        """Record liveness: any message from the runner counts as a beat."""
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            if rec is not None:
+                rec["last_beat"] = time.monotonic()
+
+    def lost_assignments(self, timeout: float):
+        """Partitions holding a trial but silent for longer than ``timeout``:
+        [(partition_id, trial_id)]. Read-only; the caller decides recovery."""
+        now = time.monotonic()
+        with self.lock:
+            return [
+                (pid, rec["trial_id"])
+                for pid, rec in self._table.items()
+                if rec.get("trial_id") is not None
+                and now - rec.get("last_beat", now) > timeout
+            ]
 
     def get(self, partition_id: int) -> Optional[Dict[str, Any]]:
         with self.lock:
@@ -119,6 +140,17 @@ class Reservations:
         with self.lock:
             if int(partition_id) in self._table:
                 self._table[int(partition_id)]["trial_id"] = trial_id
+
+    def mark_released(self, partition_id) -> None:
+        """The runner has been told GSTOP — it will send nothing more."""
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            if rec is not None:
+                rec["released"] = True
+
+    def all_released(self) -> bool:
+        with self.lock:
+            return all(rec.get("released") for rec in self._table.values())
 
     def get_assigned_trial(self, partition_id: int) -> Optional[str]:
         with self.lock:
@@ -265,6 +297,10 @@ class Server:
             events = self._sel.select(timeout=0.2)
             for key, mask in events:
                 key.data(key.fileobj, mask)
+            self._tick()
+
+    def _tick(self) -> None:
+        """Periodic hook run on the event-loop thread between selects."""
 
     def await_reservations(
         self, timeout: float = constants.REGISTRATION_TIMEOUT_S,
@@ -302,6 +338,16 @@ class OptimizationServer(Server):
 
     def __init__(self, num_executors: int, secret: Optional[str] = None):
         self.driver = None
+        # Heartbeat-loss failure detection (SURVEY.md §5.3: runner heartbeat
+        # loss => trial requeue). None disables the scan.
+        self.hb_loss_timeout: Optional[float] = None
+        self._last_loss_scan = time.monotonic()
+        # Remote-runner admission: the driver publishes the executor config
+        # here when pool="remote"; None rejects JOINs (local pools).
+        self.join_info: Optional[Dict[str, Any]] = None
+        self._join_lock = threading.Lock()
+        self._next_join_pid = 0
+        self._issued_pids: set = set()
         super().__init__(num_executors, secret)
 
     def attach_driver(self, driver) -> None:
@@ -315,7 +361,62 @@ class OptimizationServer(Server):
             FINAL=self._final,
             GET=self._get,
             LOG=self._log,
+            JOIN=self._join,
         )
+
+    def _join(self, msg):
+        """Admit a remote runner agent: assign it a partition id and ship
+        the executor config (exp_dir, hb_interval, ...). The DCN analogue of
+        Spark handing a partition to an executor — but pull, not push: agents
+        on other hosts dial in with the shared secret."""
+        info = self.join_info
+        if info is None:
+            return {"type": "ERR",
+                    "error": "this experiment does not accept remote runners"}
+        want = msg.get("partition_id")
+        with self._join_lock:
+            if want is not None and int(want) >= 0:
+                # Explicit pid: a restarted agent resuming its slot (its REG
+                # will take the re-registration BLACK path). Refuse slots
+                # outside the experiment and slots whose holder is still
+                # alive — two agents sharing a pid would interleave GET/
+                # FINAL and corrupt trial bookkeeping.
+                pid = int(want)
+                if pid >= self.num_executors:
+                    return {"type": "ERR",
+                            "error": "partition_id {} out of range (experiment "
+                                     "has {} slots)".format(pid, self.num_executors)}
+                rec = self.reservations.get(pid)
+                liveness = self.hb_loss_timeout or 10.0
+                if rec is not None and not rec.get("released") and \
+                        time.monotonic() - rec.get("last_beat", 0) < liveness:
+                    return {"type": "ERR",
+                            "error": "slot {} is held by a live runner".format(pid)}
+                self._issued_pids.add(pid)
+            else:
+                taken = set(self.reservations.all()) | self._issued_pids
+                while self._next_join_pid in taken:
+                    self._next_join_pid += 1
+                if self._next_join_pid >= self.num_executors:
+                    return {"type": "ERR", "error": "experiment full"}
+                pid = self._next_join_pid
+                self._issued_pids.add(pid)
+                self._next_join_pid += 1
+        return {"type": "JOIN", "partition_id": pid, **info}
+
+    def _tick(self) -> None:
+        if self.hb_loss_timeout is None or self.driver is None:
+            return
+        now = time.monotonic()
+        if now - self._last_loss_scan < min(1.0, self.hb_loss_timeout / 4):
+            return
+        self._last_loss_scan = now
+        for pid, trial_id in self.reservations.lost_assignments(self.hb_loss_timeout):
+            # Clear the assignment first so a racing re-registration takes
+            # the BLACK path instead of double-requeueing this trial.
+            self.reservations.assign_trial(pid, None)
+            self.driver.enqueue({"type": "LOST", "trial_id": trial_id,
+                                 "partition_id": pid})
 
     def _reg(self, msg):
         # Failure detection (reference `rpc.py:308-326`): a re-registration
@@ -335,6 +436,7 @@ class OptimizationServer(Server):
         return {"type": "OK"}
 
     def _metric(self, msg):
+        self.reservations.touch(msg["partition_id"])
         self.driver.enqueue(dict(msg))
         trial_id = msg.get("trial_id")
         stop = False
@@ -344,17 +446,20 @@ class OptimizationServer(Server):
         return {"type": "STOP"} if stop else {"type": "OK"}
 
     def _final(self, msg):
+        self.reservations.touch(msg["partition_id"])
         self.reservations.assign_trial(msg["partition_id"], None)
         self.driver.enqueue(dict(msg))
         return {"type": "OK"}
 
     def _get(self, msg):
+        self.reservations.touch(msg["partition_id"])
         # Serve an already-assigned trial BEFORE honoring experiment-done:
         # the last suggestion may be assigned concurrently with another
         # FINAL ending the experiment, and must still run.
         trial_id = self.reservations.get_assigned_trial(msg["partition_id"])
         if trial_id is None:
             if self.driver.experiment_done:
+                self.reservations.mark_released(msg["partition_id"])
                 return {"type": "GSTOP"}
             return {"type": "OK", "trial_id": None}
         trial = self.driver.get_trial(trial_id)

@@ -31,17 +31,18 @@ class RunnerPool(ABC):
         self.num_workers = num_workers
 
     @abstractmethod
-    def run(self, worker_fn: Callable[[int], None]) -> None:
+    def run(self, worker_fn: Callable[[int], None]) -> List[BaseException]:
         """Run ``worker_fn(partition_id)`` on all workers; block until done.
 
-        Worker exceptions propagate after all workers finish (the driver's
-        failure-detection path handles per-trial errors; an exception here
-        means the runner itself is broken).
+        Returns the list of runner failures (exceptions or RuntimeErrors for
+        dead processes) instead of raising: a dead runner is survivable — the
+        driver requeues its trial onto surviving runners (heartbeat-loss
+        detection) and only escalates if the experiment could not complete.
         """
 
 
 class ThreadRunnerPool(RunnerPool):
-    def run(self, worker_fn: Callable[[int], None]) -> None:
+    def run(self, worker_fn: Callable[[int], None]) -> List[BaseException]:
         errors: List[BaseException] = []
         lock = threading.Lock()
 
@@ -61,8 +62,7 @@ class ThreadRunnerPool(RunnerPool):
             t.start()
         for t in threads:
             t.join()
-        if errors:
-            raise errors[0]
+        return errors
 
 
 def _process_entry(worker_fn, pid, chip_env):
@@ -82,7 +82,7 @@ class ProcessRunnerPool(RunnerPool):
         self.start_method = start_method
         self.chip_env_fn = chip_env_fn
 
-    def run(self, worker_fn: Callable[[int], None]) -> None:
+    def run(self, worker_fn: Callable[[int], None]) -> List[BaseException]:
         ctx = mp.get_context(self.start_method)
         procs = []
         for i in range(self.num_workers):
@@ -91,13 +91,13 @@ class ProcessRunnerPool(RunnerPool):
                             name="runner-{}".format(i))
             p.start()
             procs.append(p)
-        failed = []
+        failures: List[BaseException] = []
         for p in procs:
             p.join()
             if p.exitcode != 0:
-                failed.append(p.name)
-        if failed:
-            raise RuntimeError("Runner processes failed: {}".format(failed))
+                failures.append(RuntimeError(
+                    "Runner process {} died (exit code {}).".format(p.name, p.exitcode)))
+        return failures
 
 
 class TPURunnerPool(ProcessRunnerPool):
@@ -132,3 +132,60 @@ class TPURunnerPool(ProcessRunnerPool):
         super().__init__(num_workers, start_method="spawn", chip_env_fn=chip_env)
         self.chips_per_trial = chips_per_trial
         self.total_chips = total_chips
+
+
+class RemoteRunnerPool(RunnerPool):
+    """Cross-host fan-out over DCN: runners are external agent processes
+    (``python -m maggy_tpu.runner``) on other machines — TPU VMs of a pod
+    slice — that dial the driver's control plane and JOIN.
+
+    The pool spawns nothing. It publishes a join ticket (advertised address
+    + shared secret) to the experiment directory — typically a shared
+    filesystem or GCS, the same discovery role as the reference POSTing the
+    driver address to Hopsworks REST (`hopsworks.py:129-178`) — then waits
+    for the experiment to complete. Agents may join at any time up to
+    ``num_workers``; the schedule completes with however many joined
+    (heartbeat-loss recovery covers agents dying mid-trial).
+    """
+
+    def __init__(self, driver):
+        super().__init__(driver.num_executors)
+        self.driver = driver
+
+    def ticket(self) -> dict:
+        drv = self.driver
+        host, port = drv.server_addr
+        if host in ("0.0.0.0", "", "::"):
+            host = drv.env.get_ip_address()
+        return {"host": host, "port": port, "secret": drv.secret_for_clients(),
+                "app_id": drv.app_id, "run_id": drv.run_id,
+                "num_workers": self.num_workers}
+
+    def run(self, worker_fn: Callable[[int], None]) -> List[BaseException]:
+        import json
+        import time
+
+        from maggy_tpu import constants
+
+        drv = self.driver
+        drv.env.dump(json.dumps(self.ticket(), indent=2),
+                     drv.exp_dir + "/runner_ticket.json")
+        deadline = time.monotonic() + constants.REGISTRATION_TIMEOUT_S
+        while not drv.server.reservations.all():
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "No remote runner joined within {}s; ticket at {}".format(
+                        constants.REGISTRATION_TIMEOUT_S,
+                        drv.exp_dir + "/runner_ticket.json"))
+            time.sleep(0.2)
+        while not drv.experiment_done:
+            time.sleep(0.2)
+        # Don't let the driver tear the server down under agents that have
+        # not yet observed GSTOP — their next poll would hit a dead socket
+        # and crash an otherwise-successful agent. Dead agents can't ack, so
+        # a grace cap bounds the wait.
+        ack_deadline = time.monotonic() + 10.0
+        while (not drv.server.reservations.all_released()
+               and time.monotonic() < ack_deadline):
+            time.sleep(0.1)
+        return []

@@ -1,0 +1,166 @@
+"""Remote trial-runner agent: ``python -m maggy_tpu.runner``.
+
+The DCN half of cross-host trial parallelism. The driver (pool="remote")
+publishes a join ticket — advertised address + shared secret — to the
+experiment directory; an agent on any reachable host (typically each TPU VM
+of a pod slice) dials in, JOINs to receive its partition id and executor
+config, then runs the standard trial-executor loop: register -> heartbeat ->
+get_suggestion -> train -> finalize, until GSTOP.
+
+The reference ships the train function to Spark executors by cloudpickling a
+closure (`driver.py:96-106`) — arbitrary code on the wire. Here the train
+function is named by a dotted path (``pkg.module:fn``) and imported locally
+on the agent; only declarative data crosses the network.
+
+Usage (on each runner host):
+
+    python -m maggy_tpu.runner --ticket /shared/exp_dir/runner_ticket.json \
+        --train my_project.train:train_fn
+
+or, without a shared filesystem:
+
+    python -m maggy_tpu.runner --driver 10.0.0.2:41234 --secret-file s.txt \
+        --train my_project.train:train_fn
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import socket
+import sys
+import time
+from typing import Callable, Optional, Tuple
+
+from maggy_tpu import constants
+from maggy_tpu.core.executors.trial_executor import TrialExecutor
+from maggy_tpu.core.rpc import MessageSocket
+
+
+def load_train_fn(spec: str) -> Callable:
+    """Resolve ``pkg.module:fn`` to the callable it names."""
+    mod_name, sep, fn_name = spec.partition(":")
+    if not sep or not mod_name or not fn_name:
+        raise ValueError(
+            "--train must be 'package.module:function', got {!r}".format(spec))
+    module = importlib.import_module(mod_name)
+    fn = module
+    for part in fn_name.split("."):
+        fn = getattr(fn, part)
+    if not callable(fn):
+        raise TypeError("{!r} resolved to non-callable {!r}".format(spec, fn))
+    return fn
+
+
+def join_experiment(
+    addr: Tuple[str, int], secret: str, partition_id: Optional[int] = None,
+    timeout: float = 30.0,
+) -> dict:
+    """One-shot JOIN: ask the driver for a partition id + executor config."""
+    key = secret.encode() if isinstance(secret, str) else secret
+    sock = socket.create_connection(addr, timeout=timeout)
+    try:
+        MessageSocket.send_msg(
+            sock,
+            {"type": "JOIN",
+             "partition_id": -1 if partition_id is None else partition_id},
+            key,
+        )
+        resp = MessageSocket.recv_msg(sock, key)
+    finally:
+        sock.close()
+    if resp.get("type") != "JOIN":
+        raise RuntimeError("JOIN rejected: {}".format(resp.get("error", resp)))
+    return resp
+
+
+def read_ticket(path: str, wait_s: float = 0.0) -> dict:
+    """Load the driver's join ticket, optionally waiting for it to appear
+    (the driver writes it when the experiment starts)."""
+    deadline = time.monotonic() + wait_s
+    while True:
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    ticket = json.load(f)
+                # Validate before use: the writer may not be atomic on a
+                # shared fs, so a partial read must retry, not crash.
+                ticket["host"], ticket["port"], ticket["secret"]
+                return ticket
+            except (json.JSONDecodeError, KeyError, OSError):
+                pass
+        if time.monotonic() >= deadline:
+            raise FileNotFoundError("No join ticket at {}".format(path))
+        time.sleep(0.5)
+
+
+def run_agent(
+    driver_addr: Tuple[str, int],
+    secret: str,
+    train_fn: Callable,
+    partition_id: Optional[int] = None,
+    profile: bool = False,
+) -> int:
+    """Join the experiment and run the trial-executor loop to completion.
+    Returns the partition id served."""
+    info = join_experiment(driver_addr, secret, partition_id)
+    executor = TrialExecutor(
+        server_addr=driver_addr,
+        secret=secret,
+        hb_interval=info["hb_interval"],
+        exp_dir=info["exp_dir"],
+        optimization_key=info["optimization_key"],
+        train_fn=train_fn,
+        trial_type=info.get("trial_type", "optimization"),
+        profile=profile,
+    )
+    executor(info["partition_id"])
+    return info["partition_id"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="maggy_tpu.runner", description="Remote trial-runner agent.")
+    p.add_argument("--ticket", help="path to the driver's runner_ticket.json")
+    p.add_argument("--wait-ticket", type=float, default=float(
+        os.environ.get("MAGGY_TPU_TICKET_WAIT_S", constants.REGISTRATION_TIMEOUT_S)),
+        help="seconds to wait for the ticket file to appear")
+    p.add_argument("--driver", help="driver control-plane address HOST:PORT")
+    p.add_argument("--secret", help="shared experiment secret (hex)")
+    p.add_argument("--secret-file", help="file containing the shared secret")
+    p.add_argument("--train", required=True,
+                   help="train function as 'package.module:function'")
+    p.add_argument("--partition-id", type=int, default=None,
+                   help="reclaim a specific runner slot (restart recovery)")
+    p.add_argument("--profile", action="store_true",
+                   help="capture a jax.profiler trace per trial")
+    args = p.parse_args(argv)
+
+    if args.ticket:
+        ticket = read_ticket(args.ticket, wait_s=args.wait_ticket)
+        addr = (ticket["host"], int(ticket["port"]))
+        secret = ticket["secret"]
+    elif args.driver:
+        host, _, port = args.driver.rpartition(":")
+        addr = (host, int(port))
+        if args.secret_file:
+            with open(args.secret_file) as f:
+                secret = f.read().strip()
+        elif args.secret:
+            secret = args.secret
+        else:
+            p.error("--driver requires --secret or --secret-file")
+    else:
+        p.error("one of --ticket or --driver is required")
+
+    train_fn = load_train_fn(args.train)
+    pid = run_agent(addr, secret, train_fn,
+                    partition_id=args.partition_id, profile=args.profile)
+    print("runner {} done".format(pid))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,6 +95,24 @@ class Trial:
         with self.lock:
             self.early_stop = True
 
+    def reset_run_state(self) -> None:
+        """Discard the state of a dead run before a re-run.
+
+        A requeued trial restarts from step 0 on a fresh runner; stale
+        metric history would otherwise collide with the new run's steps
+        (dedup-by-step) and a stale early-stop flag would kill it instantly.
+        Mirrors the reference wiping the trial dir on executor restart
+        (`trial_executor.py:115-119`).
+        """
+        with self.lock:
+            self.early_stop = False
+            self.final_metric = None
+            self.metric_history = []
+            self.step_history = []
+            self.metric_dict = {}
+            self.start = None
+            self.status = Trial.SCHEDULED
+
     def append_metric(self, metric: float, step: Optional[int] = None) -> bool:
         """Record a heartbeat metric; dedup by step (reference `trial.py:93-108`).
 

@@ -1,0 +1,12 @@
+"""Importable train function for remote-runner agent subprocesses.
+
+The agent (`python -m maggy_tpu.runner`) imports the train function by
+dotted path instead of receiving pickled closures over the wire.
+"""
+
+
+def train_fn(lr, units, reporter=None):
+    acc = 1.0 - ((lr - 0.1) ** 2 + ((units - 32) / 64.0) ** 2)
+    if reporter is not None:
+        reporter.broadcast(acc, step=0)
+    return {"metric": acc}

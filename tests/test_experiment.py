@@ -174,3 +174,34 @@ class TestGuards:
             OptimizationDriver(
                 OptimizationConfig(optimizer="sgd", searchspace=space()), "a", 0
             )
+
+
+def train_suicidal(lr, units, reporter=None):
+    """First trial to claim the flag file hard-kills its runner process
+    (no FINAL, no further heartbeats) — simulating a runner crash."""
+    flag = os.environ["MAGGY_TEST_KILL_FLAG"]
+    try:
+        fd = os.open(flag, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.close(fd)
+        os._exit(42)
+    except FileExistsError:
+        pass
+    return {"metric": 1.0 - (lr - 0.1) ** 2}
+
+
+class TestHeartbeatLossE2E:
+    def test_dead_runner_trial_requeued_and_experiment_completes(
+            self, local_env, tmp_path, monkeypatch):
+        monkeypatch.setenv("MAGGY_TEST_KILL_FLAG", str(tmp_path / "killed.flag"))
+        config = OptimizationConfig(
+            name="loss_e2e", num_trials=4, optimizer="randomsearch",
+            searchspace=space(), direction="max", num_workers=2,
+            hb_interval=0.1, hb_loss_timeout=2.0, seed=3,
+            es_policy="none", pool="process",
+        )
+        result = experiment.lagom(train_suicidal, config)
+        # One runner died mid-trial; its trial was requeued to the survivor
+        # and every scheduled trial still finalized.
+        assert result["num_trials"] == 4
+        assert result.get("lost_runners", 0) >= 1
+        assert os.path.exists(os.environ["MAGGY_TEST_KILL_FLAG"])

@@ -1,0 +1,122 @@
+"""Cross-host (DCN) trial execution: remote runner agents + pool="remote".
+
+The driver publishes a join ticket; external `python -m maggy_tpu.runner`
+processes dial in, JOIN for a partition id + executor config, and run the
+standard trial-executor loop. Here the "other hosts" are subprocesses on
+loopback — the protocol path is identical.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from maggy_tpu import OptimizationConfig, Searchspace, experiment
+from maggy_tpu.core.environment import EnvSing
+from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+from maggy_tpu.core.rpc import OptimizationServer
+from maggy_tpu.runner import join_experiment, load_train_fn
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(autouse=True)
+def local_env(tmp_path):
+    env = LocalEnv(base_dir=str(tmp_path / "exp"))
+    EnvSing.set_instance(env)
+    yield env
+    EnvSing.reset()
+
+
+class TestJoinProtocol:
+    def test_join_assigns_sequential_pids_and_ships_config(self):
+        server = OptimizationServer(num_executors=2)
+        server.join_info = {"hb_interval": 0.5, "exp_dir": "/tmp/x",
+                            "optimization_key": "metric",
+                            "trial_type": "optimization"}
+        addr = server.start()
+        try:
+            r0 = join_experiment(addr, server.secret_hex)
+            r1 = join_experiment(addr, server.secret_hex)
+            assert {r0["partition_id"], r1["partition_id"]} == {0, 1}
+            assert r0["exp_dir"] == "/tmp/x" and r0["hb_interval"] == 0.5
+            # Experiment full -> rejected.
+            with pytest.raises(RuntimeError, match="full"):
+                join_experiment(addr, server.secret_hex)
+            # Explicit slot reclaim always admitted (restart recovery).
+            r = join_experiment(addr, server.secret_hex, partition_id=1)
+            assert r["partition_id"] == 1
+        finally:
+            server.stop()
+
+    def test_join_rejected_without_admission(self):
+        server = OptimizationServer(num_executors=2)
+        addr = server.start()
+        try:
+            with pytest.raises(RuntimeError, match="does not accept"):
+                join_experiment(addr, server.secret_hex)
+        finally:
+            server.stop()
+
+    def test_load_train_fn_validates(self):
+        with pytest.raises(ValueError):
+            load_train_fn("no_colon_here")
+        fn = load_train_fn("json:dumps")
+        assert fn({"a": 1}) == '{"a": 1}'
+
+
+class TestRemotePoolE2E:
+    def test_remote_agents_run_the_experiment(self, local_env, tmp_path):
+        config = OptimizationConfig(
+            name="remote_e2e", num_trials=4, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2]),
+                                    units=("INTEGER", [8, 64])),
+            direction="max", num_workers=2, hb_interval=0.1, seed=11,
+            es_policy="none", pool="remote", bind_host="127.0.0.1",
+        )
+        result_box = {}
+
+        def drive():
+            result_box["result"] = experiment.lagom(
+                load_train_fn("remote_train_module:train_fn"), config)
+
+        driver_thread = threading.Thread(target=drive, daemon=True)
+        driver_thread.start()
+
+        # Wait for the join ticket the driver publishes.
+        ticket_path = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and ticket_path is None:
+            hits = glob.glob(str(tmp_path / "exp" / "*" / "runner_ticket.json"))
+            if hits:
+                ticket_path = hits[0]
+            time.sleep(0.1)
+        assert ticket_path, "driver never published runner_ticket.json"
+        ticket = json.loads(open(ticket_path).read())
+        assert ticket["num_workers"] == 2
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = TESTS_DIR + os.pathsep + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        agents = [
+            subprocess.Popen(
+                [sys.executable, "-m", "maggy_tpu.runner",
+                 "--ticket", ticket_path,
+                 "--train", "remote_train_module:train_fn"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            )
+            for _ in range(2)
+        ]
+        for a in agents:
+            out, _ = a.communicate(timeout=120)
+            assert a.returncode == 0, out.decode()
+        driver_thread.join(timeout=60)
+        assert not driver_thread.is_alive(), "driver did not finish"
+        result = result_box["result"]
+        assert result["num_trials"] == 4
+        assert result["best_val"] is not None

@@ -215,3 +215,52 @@ class TestBarrier:
                 server.await_reservations(timeout=0.3)
         finally:
             server.stop()
+
+
+class TestHeartbeatLoss:
+    """SURVEY.md §5.3: runner heartbeat loss => trial requeue. The reference
+    only recovers via Spark re-registration; this detects silent death."""
+
+    def test_lost_runner_enqueues_lost_and_clears_assignment(self, opt_server):
+        server, driver, addr = opt_server
+        server.hb_loss_timeout = 0.5
+        trial = Trial({"lr": 0.3})
+        driver.trials[trial.trial_id] = trial
+        client = make_client(addr, server)
+        client.register()
+        server.reservations.assign_trial(0, trial.trial_id)
+        client.stop()  # runner dies silently: no heartbeats, no FINAL
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if any(m["type"] == "LOST" and m["trial_id"] == trial.trial_id
+                   for m in driver.messages):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("LOST never enqueued after heartbeat silence")
+        assert server.reservations.get_assigned_trial(0) is None
+
+    def test_heartbeating_runner_is_not_flagged(self, opt_server):
+        server, driver, addr = opt_server
+        server.hb_loss_timeout = 0.6
+        trial = Trial({"lr": 0.4})
+        driver.trials[trial.trial_id] = trial
+        client = make_client(addr, server, hb=0.1)
+        client.register()
+        server.reservations.assign_trial(0, trial.trial_id)
+        reporter = Reporter()
+        reporter.reset(trial_id=trial.trial_id)
+        client.start_heartbeat(reporter)
+        time.sleep(1.5)
+        assert not any(m["type"] == "LOST" for m in driver.messages)
+        assert server.reservations.get_assigned_trial(0) == trial.trial_id
+        client.stop()
+
+    def test_unassigned_idle_runner_is_not_flagged(self, opt_server):
+        server, driver, addr = opt_server
+        server.hb_loss_timeout = 0.4
+        client = make_client(addr, server)
+        client.register()
+        client.stop()
+        time.sleep(1.0)
+        assert not any(m["type"] == "LOST" for m in driver.messages)
