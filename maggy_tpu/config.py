@@ -64,6 +64,10 @@ class OptimizationConfig(LagomConfig):
     hb_loss_timeout: Optional[float] = None
     # Experiment artifact root; defaults to the environment's base dir.
     experiment_dir: Optional[str] = None
+    # Resume the most recent interrupted run of this app: finalized trials
+    # are reloaded from their trial.json artifacts and skipped; unfinished
+    # ones re-run. Not supported with a pruner schedule.
+    resume: bool = False
 
     def __post_init__(self):
         if self.direction not in ("max", "min"):

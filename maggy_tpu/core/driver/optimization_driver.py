@@ -47,6 +47,11 @@ class OptimizationDriver(Driver):
         # Pruner must exist BEFORE sizing the schedule: it owns num_trials
         # when multi-fidelity (reference `optimization_driver.py:63-65`).
         self.controller.init_pruner()
+        if getattr(config, "resume", False):
+            # Validate BEFORE super().__init__ re-registers the experiment
+            # dir — a late failure would have already clobbered the
+            # interrupted run's experiment.json.
+            self._validate_resume()
         self.num_trials = self._resolve_num_trials(config)
         self.num_executors = min(config.num_workers, self.num_trials)
         super().__init__(config, app_id, run_id)
@@ -83,6 +88,8 @@ class OptimizationDriver(Driver):
                        "avg": None, "num_trials": 0, "early_stopped": 0}
         self.job_start: Optional[float] = None
         self.maggy_log = ""
+        if getattr(config, "resume", False):
+            self._restore_previous_run()
 
     # --------------------------------------------------------------- set up
 
@@ -168,6 +175,43 @@ class OptimizationDriver(Driver):
 
     def secret_for_clients(self) -> str:
         return self.server.secret_hex
+
+    def _validate_resume(self) -> None:
+        from maggy_tpu.optimizers.bayes.base import BaseAsyncBO
+
+        if self.controller.pruner is not None:
+            raise ValueError(
+                "resume=True is not supported with a pruner (Hyperband) "
+                "schedule; its bracket state is not checkpointed."
+            )
+        if isinstance(self.controller, (RandomSearch, BaseAsyncBO)) \
+                and self.controller.seed is None:
+            raise ValueError(
+                "resume=True with {} requires a fixed seed: an unseeded "
+                "rerun presamples a different schedule and would re-run "
+                "everything on top of the restored trials.".format(
+                    type(self.controller).__name__))
+
+    def _restore_previous_run(self) -> None:
+        """Experiment resume (beyond the reference, SURVEY.md §5.4): reload
+        every finalized trial.json from the experiment dir, rebuild result
+        aggregates, and let the controller drop already-executed configs.
+        The interrupted run's unfinished trials simply re-run."""
+        restored: List[Trial] = []
+        for name in sorted(self.env.ls(self.exp_dir)):
+            path = "{}/{}/trial.json".format(self.exp_dir, name)
+            if not self.env.exists(path):
+                continue
+            trial = Trial.from_json(self.env.load(path))
+            if trial.status == Trial.FINALIZED and trial.final_metric is not None:
+                restored.append(trial)
+        with self._store_lock:
+            self._final_store.extend(restored)
+        for trial in restored:
+            self._update_result(trial)
+        self.controller.restore(restored)
+        self._log("resume: restored {} finalized trials from {}".format(
+            len(restored), self.exp_dir))
 
     # ------------------------------------------------------------ callbacks
 

@@ -45,6 +45,12 @@ def lagom(train_fn: Callable, config: LagomConfig) -> Any:
     # experiment_dir must not collide at run 0), via the env's own fs.
     base = getattr(config, "experiment_dir", None) or env.experiment_base_dir()
     RUN_ID = util.next_run_id(base, APP_ID, env=env)
+    if getattr(config, "resume", False):
+        if RUN_ID == 0:
+            raise ValueError(
+                "resume=True but no previous run of app '{}' exists under "
+                "{}".format(APP_ID, base))
+        RUN_ID -= 1  # re-enter the most recent run's directory
     RUNNING = True
     driver = None
     try:

@@ -57,6 +57,22 @@ class AbstractOptimizer(ABC):
     def finalize_experiment(self, trials: List[Trial]) -> None:
         """Called once after the experiment completes."""
 
+    def restore(self, finalized: List[Trial]) -> None:
+        """Rebuild schedule state from a previous run's finalized trials
+        (experiment resume — the reference cannot resume an interrupted
+        schedule, SURVEY.md §5.4). The driver has already populated
+        final_store; subclasses drop already-executed configs from their
+        sampling buffers / rebuild bookkeeping. Default: rely on
+        final_store alone."""
+
+    @staticmethod
+    def _drop_executed(buffer: List[dict], finalized: List[Trial]) -> List[dict]:
+        """Filter a config buffer down to configs the previous run did NOT
+        execute (trial ids are content-addressed md5s of the params)."""
+        done = {t.trial_id for t in finalized}
+        return [c for c in buffer
+                if Trial._compute_id(dict(c), "optimization") not in done]
+
     # ------------------------------------------------------------- plumbing
 
     def _initialize(self, exp_dir: Optional[str] = None) -> None:

@@ -114,6 +114,18 @@ class Asha(AbstractOptimizer):
         params["budget"] = self.rung_budget(0)
         return Trial(params, info_dict={"sample_type": "random", "rung": 0})
 
+    def restore(self, finalized) -> None:
+        """Rebuild the rung ladder from a previous run: each finalized trial
+        re-enters its rung, and a promoted child marks its parent as already
+        promoted out of the rung below (in-flight promotions at crash time
+        are simply re-derived — same parent, same budget, same trial id)."""
+        for t in finalized:
+            rung = t.info_dict.get("rung", 0)
+            self.rungs.setdefault(rung, []).append(t.trial_id)
+            parent = t.info_dict.get("parent")
+            if parent is not None and rung > 0:
+                self.promoted.setdefault(rung - 1, []).append(parent)
+
     def _lookup_params(self, trial_id: str) -> dict:
         for t in self.final_store:
             if t.trial_id == trial_id:

@@ -98,6 +98,13 @@ class BaseAsyncBO(AbstractOptimizer):
             self.pruner.report_trial(None, new_trial.trial_id)
         return new_trial
 
+    def restore(self, finalized) -> None:
+        # final_store (already repopulated by the driver) is the surrogate's
+        # training data; only the warmup buffer needs dedup against the
+        # previous run (the driver enforces a fixed seed for resume, so the
+        # rerun presamples the same warmup configs).
+        self.warmup_buffer = self._drop_executed(self.warmup_buffer, finalized)
+
     def _propose(self, budget: float) -> Optional[Trial]:
         # 1. warmup buffer
         if self.warmup_buffer:

@@ -33,3 +33,7 @@ class GridSearch(AbstractOptimizer):
             return None
         params = self.config_buffer.pop(0)
         return self.create_trial(params, sample_type="grid")
+
+    def restore(self, finalized) -> None:
+        # The grid is deterministic; drop cells the previous run covered.
+        self.config_buffer = self._drop_executed(self.config_buffer, finalized)

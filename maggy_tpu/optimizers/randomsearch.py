@@ -39,6 +39,13 @@ class RandomSearch(AbstractOptimizer):
         params = self.config_buffer.pop(0)
         return self.create_trial(params, sample_type="random")
 
+    def restore(self, finalized) -> None:
+        # Same seed => same presampled buffer; drop the configs the previous
+        # run already executed. (The driver refuses resume when the seed is
+        # None — an unseeded rerun would presample a disjoint buffer and
+        # silently over-run the schedule.)
+        self.config_buffer = self._drop_executed(self.config_buffer, finalized)
+
     def _pruner_suggestion(self, trial: Optional[Trial]):
         """Delegate budget/promotion decisions to the pruner (reference
         `randomsearch.py:47-90`)."""

@@ -19,13 +19,18 @@ class SingleRun(AbstractOptimizer):
         super().__init__(seed=seed)
 
     def initialize(self) -> None:
-        self._remaining = self.num_trials
-
-    def get_suggestion(self, trial: Optional[Trial] = None):
-        if self._remaining <= 0:
-            return None
-        self._remaining -= 1
         # Distinguish otherwise-identical empty-param trials by an index so
         # their md5 ids differ.
-        return self.create_trial({"run_index": self.num_trials - self._remaining - 1},
+        self._pending = list(range(self.num_trials))
+
+    def get_suggestion(self, trial: Optional[Trial] = None):
+        if not self._pending:
+            return None
+        return self.create_trial({"run_index": self._pending.pop(0)},
                                  sample_type="random")
+
+    def restore(self, finalized) -> None:
+        # Parallel runners finish out of order: skip exactly the indices
+        # that finalized, not a count (index 3 may finish before index 2).
+        done = {t.params.get("run_index") for t in finalized}
+        self._pending = [i for i in self._pending if i not in done]

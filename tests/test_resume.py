@@ -1,0 +1,186 @@
+"""Experiment resume: an interrupted schedule continues instead of
+restarting (the reference cannot do this — SURVEY.md §5.4: "an interrupted
+experiment cannot resume its schedule").
+"""
+
+import os
+
+import pytest
+
+from maggy_tpu import OptimizationConfig, Searchspace, experiment
+from maggy_tpu.core.environment import EnvSing
+from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+from maggy_tpu.optimizers import Asha
+from maggy_tpu.trial import Trial
+
+
+@pytest.fixture(autouse=True)
+def local_env(tmp_path):
+    env = LocalEnv(base_dir=str(tmp_path / "exp"))
+    EnvSing.set_instance(env)
+    yield env
+    EnvSing.reset()
+
+
+def train_counting(lr, units, reporter=None):
+    """Leaves one marker file per distinct executed config."""
+    marker = os.path.join(os.environ["MAGGY_TEST_COUNT_DIR"],
+                          "{:.12f}_{}".format(lr, units))
+    with open(marker, "a") as f:
+        f.write("x")
+    return {"metric": 1.0 - (lr - 0.1) ** 2}
+
+
+def space():
+    return Searchspace(lr=("DOUBLE", [0.0, 0.2]), units=("INTEGER", [8, 64]))
+
+
+def cfg(**kw):
+    base = dict(name="resume", optimizer="randomsearch", searchspace=space(),
+                direction="max", num_workers=2, hb_interval=0.05, seed=5,
+                es_policy="none")
+    base.update(kw)
+    return OptimizationConfig(**base)
+
+
+class TestResumeE2E:
+    def test_resume_skips_already_finalized_trials(self, tmp_path, monkeypatch):
+        count_dir = tmp_path / "counts"
+        count_dir.mkdir()
+        monkeypatch.setenv("MAGGY_TEST_COUNT_DIR", str(count_dir))
+        exp_base = str(tmp_path / "exp")
+
+        # "Interrupted" run: 3 of the eventual 6 trials complete.
+        r1 = experiment.lagom(train_counting,
+                              cfg(num_trials=3, experiment_dir=exp_base))
+        assert r1["num_trials"] == 3
+        first_markers = set(os.listdir(count_dir))
+        assert len(first_markers) == 3
+
+        # Resume with the full schedule (same seed => same presampled
+        # buffer; the first 3 configs are recognized and skipped).
+        r2 = experiment.lagom(train_counting,
+                              cfg(num_trials=6, experiment_dir=exp_base,
+                                  resume=True))
+        assert r2["num_trials"] == 6  # 3 restored + 3 fresh
+        markers = os.listdir(count_dir)
+        assert len(markers) == 6
+        # The original 3 were NOT re-executed (each marker written once).
+        for m in first_markers:
+            assert os.path.getsize(count_dir / m) == 1
+        # Both runs share one experiment directory (run id reused).
+        assert len(os.listdir(exp_base)) == 1
+
+    def test_resume_without_prior_run_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="no previous run"):
+            experiment.lagom(train_counting,
+                             cfg(num_trials=2, resume=True,
+                                 experiment_dir=str(tmp_path / "fresh")))
+
+    def test_resume_with_pruner_rejected(self, tmp_path, monkeypatch):
+        count_dir = tmp_path / "counts2"
+        count_dir.mkdir()
+        monkeypatch.setenv("MAGGY_TEST_COUNT_DIR", str(count_dir))
+        exp_base = str(tmp_path / "exp")
+        experiment.lagom(train_counting,
+                         cfg(num_trials=2, experiment_dir=exp_base))
+        from maggy_tpu.optimizers import RandomSearch
+
+        with pytest.raises(ValueError, match="pruner"):
+            experiment.lagom(
+                train_counting,
+                cfg(num_trials=27, resume=True, experiment_dir=exp_base,
+                    optimizer=RandomSearch(pruner="hyperband",
+                                           pruner_kwargs={"max_budget": 9})))
+
+
+def train_indexed(run_index, reporter=None):
+    marker = os.path.join(os.environ["MAGGY_TEST_COUNT_DIR"],
+                          "run_{}".format(run_index))
+    with open(marker, "a") as f:
+        f.write("x")
+    return {"metric": float(run_index)}
+
+
+class TestInterruptedRunResume:
+    def test_out_of_order_finalized_indices(self, tmp_path, monkeypatch):
+        """A genuinely interrupted run: indices 0, 1, 3 finalized (3 finished
+        before 2 — parallel runners complete out of order), 2 and 4 never
+        ran. Resume must execute exactly 2 and 4."""
+        count_dir = tmp_path / "counts"
+        count_dir.mkdir()
+        monkeypatch.setenv("MAGGY_TEST_COUNT_DIR", str(count_dir))
+        monkeypatch.setattr(experiment, "APP_ID", "resumeapp")
+        exp_base = tmp_path / "exp"
+        exp_dir = exp_base / "resumeapp_0"
+        exp_dir.mkdir(parents=True)
+        (exp_dir / "experiment.json").write_text(
+            '{"name": "interrupted", "state": "RUNNING"}')
+        for idx, metric in [(0, 0.0), (1, 1.0), (3, 3.0)]:
+            t = Trial({"run_index": idx})
+            t.status = Trial.FINALIZED
+            t.final_metric = metric
+            (exp_dir / t.trial_id).mkdir()
+            (exp_dir / t.trial_id / "trial.json").write_text(t.to_json())
+
+        result = experiment.lagom(
+            train_indexed,
+            OptimizationConfig(name="interrupted", optimizer="none",
+                               num_trials=5, num_workers=2, hb_interval=0.05,
+                               es_policy="none", direction="max",
+                               experiment_dir=str(exp_base), resume=True))
+        assert result["num_trials"] == 5  # 3 restored + 2 fresh
+        assert sorted(os.listdir(count_dir)) == ["run_2", "run_4"]
+        assert result["best_val"] == 4.0  # metric == index; 4 is fresh-best
+
+    def test_unseeded_randomsearch_resume_rejected(self, tmp_path):
+        exp_base = tmp_path / "exp"
+        (exp_base / "resumeapp2_0").mkdir(parents=True)
+        import maggy_tpu.experiment as exp_mod
+
+        old = exp_mod.APP_ID
+        exp_mod.APP_ID = "resumeapp2"
+        try:
+            with pytest.raises(ValueError, match="fixed seed"):
+                experiment.lagom(
+                    train_counting,
+                    cfg(num_trials=4, seed=None,
+                        experiment_dir=str(exp_base), resume=True))
+        finally:
+            exp_mod.APP_ID = old
+
+
+class TestAshaRestore:
+    def test_rungs_and_promotions_rebuilt(self):
+        asha = Asha(reduction_factor=2, resource_min=1, resource_max=4, seed=0)
+        asha.searchspace = space()
+        asha.num_trials = 4
+        asha.trial_store = {}
+        asha.final_store = []
+        asha.direction = "max"
+        asha.initialize()
+
+        def finalized(params, rung, metric, parent=None):
+            info = {"rung": rung}
+            if parent:
+                info["parent"] = parent
+            t = Trial(params, info_dict=info)
+            t.status = Trial.FINALIZED
+            t.final_metric = metric
+            return t
+
+        t1 = finalized({"lr": 0.1, "units": 16, "budget": 1}, 0, 0.9)
+        t2 = finalized({"lr": 0.2, "units": 32, "budget": 1}, 0, 0.5)
+        t3 = finalized({"lr": 0.1, "units": 16, "budget": 2}, 1, 0.95,
+                       parent=t1.trial_id)
+        asha.final_store.extend([t1, t2, t3])
+        asha.restore([t1, t2, t3])
+
+        assert asha.rungs[0] == [t1.trial_id, t2.trial_id]
+        assert asha.rungs[1] == [t3.trial_id]
+        # t1 must not be promoted again out of rung 0.
+        assert asha.promoted[0] == [t1.trial_id]
+        suggestion = asha.get_suggestion(None)
+        if isinstance(suggestion, Trial):
+            assert suggestion.info_dict.get("parent") != t1.trial_id or \
+                suggestion.info_dict.get("rung") != 1
