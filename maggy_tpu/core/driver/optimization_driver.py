@@ -209,6 +209,9 @@ class OptimizationDriver(Driver):
             self._final_store.extend(restored)
         for trial in restored:
             self._update_result(trial)
+        # Carry the interrupted run's early-stop count so the resumed
+        # result.json covers all the trials it claims to.
+        self.result["early_stopped"] += sum(1 for t in restored if t.early_stop)
         self.controller.restore(restored)
         self._log("resume: restored {} finalized trials from {}".format(
             len(restored), self.exp_dir))

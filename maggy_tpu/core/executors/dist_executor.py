@@ -20,6 +20,7 @@ import os
 import traceback
 from typing import Callable, Optional, Tuple
 
+from maggy_tpu import util
 from maggy_tpu.core.environment import EnvSing
 from maggy_tpu.core.reporter import Reporter
 from maggy_tpu.core.rpc import Client
@@ -49,6 +50,7 @@ class DistExecutor:
 
     def __call__(self, partition_id: int) -> None:
         env = EnvSing.get_instance()
+        util.enable_compile_cache()
         task_attempt = int(os.environ.get("MAGGY_TPU_TASK_ATTEMPT", "0"))
         reporter = Reporter(
             log_file="{}/worker_{}_{}.log".format(self.exp_dir, partition_id, task_attempt)

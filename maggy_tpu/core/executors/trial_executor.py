@@ -62,6 +62,10 @@ class TrialExecutor:
     def __call__(self, partition_id: int) -> None:
         env = EnvSing.get_instance()
         exp_dir = self.exp_dir
+        # Shared persistent XLA cache: successive trials (and sibling runner
+        # processes) with recurring shapes skip recompilation (SURVEY.md
+        # §7.3 "compile-cache churn").
+        util.enable_compile_cache()
         task_attempt = int(os.environ.get("MAGGY_TPU_TASK_ATTEMPT", "0"))
         reporter = Reporter(
             log_file="{}/executor_{}_{}.log".format(exp_dir, partition_id, task_attempt)

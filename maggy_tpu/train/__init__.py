@@ -2,9 +2,12 @@ from maggy_tpu.train.trainer import (
     cross_entropy_loss,
     init_train_state,
     make_train_step,
+    next_token_loss,
+    swept_transform,
     Trainer,
 )
 from maggy_tpu.train.data import ShardedBatchIterator
 
 __all__ = ["cross_entropy_loss", "init_train_state", "make_train_step",
-           "Trainer", "ShardedBatchIterator"]
+           "next_token_loss", "swept_transform", "Trainer",
+           "ShardedBatchIterator"]

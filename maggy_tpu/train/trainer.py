@@ -62,11 +62,13 @@ def init_train_state(
     mesh,
     strategy: str = "dp",
     init_kwargs: Optional[Dict[str, Any]] = None,
+    cache_key: Optional[tuple] = None,
 ):
     """Initialize (params, opt_state) directly INTO their shardings.
 
     Returns (params, opt_state, shardings) where params is the full flax
-    variables dict minus boxes.
+    variables dict minus boxes. ``cache_key`` shares the jitted initializer
+    across trials of a sweep (same contract as Trainer's step_key).
     """
     init_kwargs = init_kwargs or {}
 
@@ -76,16 +78,29 @@ def init_train_state(
         # recomputed every step, not trained state.
         return {k: v for k, v in variables.items() if k != "losses"}
 
-    abstract = jax.eval_shape(init_fn, rng)
-    _, shardings = _unbox_and_specs(abstract, mesh, strategy)
+    def build():
+        abstract = jax.eval_shape(init_fn, rng)
+        _, shardings = _unbox_and_specs(abstract, mesh, strategy)
 
-    def init_unboxed(rng):
-        variables = init_fn(rng)
-        plain, _ = _unbox_and_specs(variables, mesh, strategy)
-        return plain
+        def init_unboxed(rng):
+            variables = init_fn(rng)
+            plain, _ = _unbox_and_specs(variables, mesh, strategy)
+            return plain
 
+        return jax.jit(init_unboxed, out_shardings=shardings), shardings
+
+    if cache_key is not None:
+        shapes = jax.tree_util.tree_map(jnp.shape, example_inputs)
+        key = ("init", cache_key, model, mesh, strategy, repr(shapes),
+               repr(sorted(init_kwargs.items())))
+        with _STEP_CACHE_LOCK:
+            if key not in _STEP_CACHE:
+                _STEP_CACHE[key] = build()
+            init_jit, shardings = _STEP_CACHE[key]
+    else:
+        init_jit, shardings = build()
     with mesh:
-        params = jax.jit(init_unboxed, out_shardings=shardings)(rng)
+        params = init_jit(rng)
         opt_state = tx.init(params["params"] if "params" in params else params)
     return params, opt_state, shardings
 
@@ -138,24 +153,81 @@ def make_train_step(
     return jax.jit(step, **jit_kwargs)
 
 
+import threading as _threading
+
+# Compiled-step sharing across trials (opt-in via Trainer(step_key=...)).
+_STEP_CACHE: Dict[Any, Callable] = {}
+_STEP_CACHE_LOCK = _threading.Lock()
+
+
+def _has_injected_hparams(state) -> bool:
+    """True if any sub-state carries injected hyperparams (swept_transform
+    may sit anywhere inside an optax.chain)."""
+    if hasattr(state, "hyperparams"):
+        return True
+    if isinstance(state, (tuple, list)):
+        return any(_has_injected_hparams(s) for s in state)
+    return False
+
+
+def swept_transform(opt_factory: Callable, **hparams):
+    """Build an optax transform whose hyperparameters are TRACED INPUTS
+    (carried in opt_state) instead of baked-in constants.
+
+    ``swept_transform(optax.adam, learning_rate=lr)`` produces identical HLO
+    for every lr, so a sweep compiles its train step ONCE: combine with
+    ``Trainer(step_key=...)`` for in-process sharing, and the persistent
+    compilation cache dedups across runner processes (SURVEY.md §7.3
+    "compile-cache churn" — the TPU-native answer is hparams-as-inputs, not
+    N recompiles).
+    """
+    import optax
+
+    return optax.inject_hyperparams(opt_factory)(**hparams)
+
+
 class Trainer:
     """Convenience loop: init + step + reporter integration.
 
     The per-trial training harness for HPO sweeps (models from the zoo,
     optax optimizer, metric heartbeats via the Reporter).
+
+    ``step_key``: opt-in compiled-step sharing for sweeps. Trials whose
+    (step_key, model, mesh, strategy) coincide reuse one jitted step — pair
+    it with ``swept_transform`` so the optimizer's hyperparameters live in
+    opt_state rather than the program. Include the optimizer family in the
+    key if the sweep varies it (e.g. ``step_key=("mnist", "adam")``).
     """
 
     def __init__(self, model, tx, loss_fn, mesh, strategy: str = "dp",
                  train_kwargs: Optional[Dict[str, Any]] = None,
-                 has_aux_collections: bool = False):
+                 has_aux_collections: bool = False,
+                 step_key: Optional[tuple] = None):
         self.model = model
         self.tx = tx
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.strategy = strategy
-        self._step = make_train_step(model, tx, loss_fn, mesh,
-                                     train_kwargs=train_kwargs,
-                                     has_aux_collections=has_aux_collections)
+        build = functools.partial(
+            make_train_step, model, tx, loss_fn, mesh,
+            train_kwargs=train_kwargs,
+            has_aux_collections=has_aux_collections)
+        self._step_key = step_key
+        self._step_shared = step_key is not None
+        if step_key is not None:
+            # Flax modules are frozen dataclasses and Mesh hashes by
+            # topology, so the key pins the program identity; tx is
+            # deliberately excluded (that's the point — see swept_transform).
+            # loss_fn keys by object identity: a per-call lambda simply
+            # misses the cache (safe), a module-level loss shares.
+            key = (step_key, model, mesh, strategy, has_aux_collections,
+                   loss_fn, repr(sorted((train_kwargs or {}).items())))
+            with _STEP_CACHE_LOCK:
+                if key not in _STEP_CACHE:
+                    _STEP_CACHE[key] = build()
+                self._step = _STEP_CACHE[key]
+        else:
+            self._step = build()
         self.variables = None
         self.opt_state = None
         self.shardings = None
@@ -163,7 +235,17 @@ class Trainer:
     def init(self, rng, example_inputs, init_kwargs=None):
         self.variables, self.opt_state, self.shardings = init_train_state(
             self.model, self.tx, rng, example_inputs, self.mesh,
-            self.strategy, init_kwargs=init_kwargs)
+            self.strategy, init_kwargs=init_kwargs,
+            cache_key=self._step_key)
+        if self._step_shared and not _has_injected_hparams(self.opt_state):
+            import warnings
+
+            warnings.warn(
+                "Trainer(step_key=...) shares one compiled step across "
+                "trials, but this tx bakes its hyperparameters into the "
+                "program (use swept_transform) — all sharing trials will "
+                "silently run the FIRST trial's optimizer constants.",
+                stacklevel=2)
         return self
 
     def place_batch(self, batch: Dict[str, Any]):

@@ -110,3 +110,39 @@ def next_run_id(base_dir: str, app_id: str, env=None) -> int:
     while env.exists("{}/{}_{}".format(base_dir.rstrip("/"), app_id, i)):
         i += 1
     return i
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Arm JAX's persistent XLA compilation cache.
+
+    64 concurrent trials with differing hparams compile distinct XLA
+    programs (SURVEY.md §7.3 "compile-cache churn"); a shared on-disk cache
+    lets runner processes — and successive trials with recurring shapes —
+    reuse compiled executables instead of paying the 20-40s TPU compile
+    again. Safe to call repeatedly; disabled by MAGGY_TPU_NO_COMPILE_CACHE=1.
+    Returns the cache dir, or None when disabled/unavailable.
+    """
+    if os.environ.get("MAGGY_TPU_NO_COMPILE_CACHE") == "1":
+        return None
+    if cache_dir is None and os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+            and "MAGGY_TPU_COMPILE_CACHE_DIR" not in os.environ:
+        # XLA:CPU AOT cache entries embed host ISA features and warn (or
+        # SIGILL) on reuse across machines; the cache pays off on TPU where
+        # compiles cost 20-40s, so default it off for CPU runs/tests.
+        return None
+    cache_dir = cache_dir or os.environ.get(
+        "MAGGY_TPU_COMPILE_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "maggy_tpu_xla"),
+    )
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Cache every program: trial workloads are small, recompiles are the
+        # bottleneck (defaults skip sub-second compiles).
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return cache_dir
+    except Exception:  # noqa: BLE001 - cache is an optimization, never fatal
+        return None
