@@ -113,5 +113,17 @@ class DistributedConfig(LagomConfig):
     mesh_shape: Dict[str, int] = field(default_factory=dict)
     #: Parallelism strategy name: "dp", "fsdp", "tp", "dp_tp", "sp".
     strategy: str = "dp"
+    #: Worker substrate: None/"process" (local processes), "thread" (tests),
+    #: "remote" (external `python -m maggy_tpu.runner` agents over DCN).
     backend: Optional[str] = None
+    #: Control-plane bind host; defaults to 0.0.0.0 when backend="remote".
+    bind_host: Optional[str] = None
+    #: Declare a worker dead after this many seconds of heartbeat silence
+    #: (the experiment fails — a dead SPMD rank wedges the world).
+    #: None -> max(HEARTBEAT_LOSS_MIN_S, hb_interval * HEARTBEAT_LOSS_FACTOR).
+    hb_loss_timeout: Optional[float] = None
     experiment_dir: Optional[str] = None
+
+    def __post_init__(self):
+        if self.bind_host is None and self.backend == "remote":
+            self.bind_host = "0.0.0.0"

@@ -22,20 +22,48 @@ from maggy_tpu.core.runner_pool import ProcessRunnerPool, ThreadRunnerPool
 class DistributedDriver(Driver):
     def __init__(self, config: DistributedConfig, app_id: str, run_id: int):
         self.num_workers = config.num_workers
+        self.num_executors = config.num_workers  # RemoteRunnerPool contract
         super().__init__(config, app_id, run_id)
         self.results: List[float] = []
+        self._finals = 0
+        self._worker_errors = 0
         self._results_lock = threading.Lock()
         self.job_start = None
+        # A silent SPMD worker deadlocks the whole world's collectives —
+        # heartbeat loss surfaces it as a failed experiment rather than a
+        # hang (see DistributedServer._tick).
+        from maggy_tpu import constants
+
+        self.server.hb_loss_timeout = getattr(config, "hb_loss_timeout", None) or max(
+            constants.HEARTBEAT_LOSS_MIN_S,
+            self.hb_interval * constants.HEARTBEAT_LOSS_FACTOR,
+        )
 
     def _make_server(self):
         return DistributedServer(self.num_workers, secret=self.secret)
 
     def _make_runner_pool(self):
+        backend = getattr(self.config, "backend", None)
+        if backend == "remote":
+            # Multi-host SPMD: each TPU VM runs `python -m maggy_tpu.runner
+            # --train mod:fn` and JOINs; worker 0's advertised endpoint
+            # becomes the jax.distributed coordinator.
+            from maggy_tpu.core.runner_pool import RemoteRunnerPool
+
+            self.server.join_info = {
+                "hb_interval": self.hb_interval,
+                "exp_dir": self.exp_dir,
+                "optimization_key": "metric",
+                "trial_type": "distributed",
+                "num_workers": self.num_workers,
+                "mesh_shape": dict(self.config.mesh_shape or {}),
+                "strategy": self.config.strategy,
+            }
+            return RemoteRunnerPool(self)
         # Real multi-process SPMD needs one JAX runtime per worker; a single
         # worker (or tests) can run in-thread.
         if self.num_workers == 1:
             return ThreadRunnerPool(1)
-        backend = getattr(self.config, "backend", None)
         if backend == "thread":
             return ThreadRunnerPool(self.num_workers)
         return ProcessRunnerPool(self.num_workers)
@@ -55,21 +83,44 @@ class DistributedDriver(Driver):
         self.message_callbacks.update(
             METRIC=self._log_msg_callback,
             FINAL=self._final_msg_callback,
+            DEAD_WORKER=self._dead_worker_msg_callback,
         )
+
+    def _dead_worker_msg_callback(self, msg) -> None:
+        self.exception = RuntimeError(
+            "Distributed worker {} stopped heartbeating; a dead rank wedges "
+            "the SPMD world, aborting the experiment.".format(msg["partition_id"]))
+        self.experiment_done = True
 
     def _log_msg_callback(self, msg) -> None:
         self.add_executor_logs(msg.get("logs"))
 
     def _final_msg_callback(self, msg) -> None:
         self.add_executor_logs(msg.get("logs"))
-        if msg.get("value") is not None:
-            with self._results_lock:
+        with self._results_lock:
+            self._finals += 1
+            done = self._finals >= self.num_workers
+            if msg.get("error"):
+                self._worker_errors += 1
+            elif msg.get("value") is not None:
                 self.results.append(float(msg["value"]))
+        if done:
+            # All workers reported: lets the remote pool stop waiting (local
+            # pools end when their worker processes return).
+            self.experiment_done = True
 
     def _exp_startup_callback(self) -> None:
         self.job_start = time.time()
 
     def _exp_final_callback(self, job_end: float, exp_json: Dict[str, Any]):
+        with self._results_lock:
+            if self._worker_errors:
+                # A failed rank means the "average" covers a partial world —
+                # that is a failed experiment, not a FINISHED one.
+                raise RuntimeError(
+                    "{} of {} distributed workers failed (see worker logs in "
+                    "{}).".format(self._worker_errors, self.num_workers,
+                                  self.exp_dir))
         with self._results_lock:
             avg = sum(self.results) / len(self.results) if self.results else None
         result = {"average_metric": avg, "per_worker": list(self.results),

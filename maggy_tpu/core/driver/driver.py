@@ -161,6 +161,9 @@ class Driver(ABC):
     def enqueue(self, msg: Dict[str, Any]) -> None:
         self._message_q.put(msg)
 
+    def secret_for_clients(self) -> str:
+        return self.server.secret_hex
+
     def get_trial(self, trial_id: str):
         return None
 

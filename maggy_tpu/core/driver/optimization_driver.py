@@ -173,9 +173,6 @@ class OptimizationDriver(Driver):
             profile=getattr(self.config, "profile", False),
         )
 
-    def secret_for_clients(self) -> str:
-        return self.server.secret_hex
-
     def _validate_resume(self) -> None:
         from maggy_tpu.optimizers.bayes.base import BaseAsyncBO
 

@@ -123,6 +123,18 @@ class Reservations:
                 and now - rec.get("last_beat", now) > timeout
             ]
 
+    def silent(self, timeout: float):
+        """Registered, unreleased partitions silent for longer than
+        ``timeout`` — regardless of trial assignment (distributed workers
+        hold no trials but must heartbeat for their whole run)."""
+        now = time.monotonic()
+        with self.lock:
+            return [
+                pid for pid, rec in self._table.items()
+                if not rec.get("released")
+                and now - rec.get("last_beat", now) > timeout
+            ]
+
     def get(self, partition_id: int) -> Optional[Dict[str, Any]]:
         with self.lock:
             rec = self._table.get(int(partition_id))
@@ -177,6 +189,15 @@ class Server:
         self.secret_hex = secret or pysecrets.token_hex(16)
         self.secret = self.secret_hex.encode()
         self.reservations = Reservations(num_executors)
+        # Remote-runner admission: the driver publishes the executor config
+        # here when runners are external agents; None rejects JOINs.
+        self.join_info: Optional[Dict[str, Any]] = None
+        self._join_lock = threading.Lock()
+        self._next_join_pid = 0
+        self._issued_pids: set = set()
+        # Heartbeat-liveness bound used by JOIN slot-reclaim checks (and, in
+        # OptimizationServer, the loss scan). None disables.
+        self.hb_loss_timeout: Optional[float] = None
         self._buffers: Dict[socket.socket, bytearray] = {}
         self._sel = selectors.DefaultSelector()
         self._listener: Optional[socket.socket] = None
@@ -191,6 +212,47 @@ class Server:
             "type": "QUERY",
             "done": self.reservations.done(),
         }
+        self._handlers["JOIN"] = self._join
+
+    def _join(self, msg):
+        """Admit a remote runner agent: assign it a partition id and ship
+        the executor config (exp_dir, hb_interval, ...). The DCN analogue of
+        Spark handing a partition to an executor — but pull, not push: agents
+        on other hosts dial in with the shared secret."""
+        info = self.join_info
+        if info is None:
+            return {"type": "ERR",
+                    "error": "this experiment does not accept remote runners"}
+        want = msg.get("partition_id")
+        with self._join_lock:
+            if want is not None and int(want) >= 0:
+                # Explicit pid: a restarted agent resuming its slot (its REG
+                # will take the re-registration BLACK path). Refuse slots
+                # outside the experiment and slots whose holder is still
+                # alive — two agents sharing a pid would interleave GET/
+                # FINAL and corrupt trial bookkeeping.
+                pid = int(want)
+                if pid >= self.num_executors:
+                    return {"type": "ERR",
+                            "error": "partition_id {} out of range (experiment "
+                                     "has {} slots)".format(pid, self.num_executors)}
+                rec = self.reservations.get(pid)
+                liveness = self.hb_loss_timeout or 10.0
+                if rec is not None and not rec.get("released") and \
+                        time.monotonic() - rec.get("last_beat", 0) < liveness:
+                    return {"type": "ERR",
+                            "error": "slot {} is held by a live runner".format(pid)}
+                self._issued_pids.add(pid)
+            else:
+                taken = set(self.reservations.all()) | self._issued_pids
+                while self._next_join_pid in taken:
+                    self._next_join_pid += 1
+                if self._next_join_pid >= self.num_executors:
+                    return {"type": "ERR", "error": "experiment full"}
+                pid = self._next_join_pid
+                self._issued_pids.add(pid)
+                self._next_join_pid += 1
+        return {"type": "JOIN", "partition_id": pid, **info}
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
         # Warm the native codec BEFORE the event loop exists: the lazy g++
@@ -338,16 +400,7 @@ class OptimizationServer(Server):
 
     def __init__(self, num_executors: int, secret: Optional[str] = None):
         self.driver = None
-        # Heartbeat-loss failure detection (SURVEY.md §5.3: runner heartbeat
-        # loss => trial requeue). None disables the scan.
-        self.hb_loss_timeout: Optional[float] = None
         self._last_loss_scan = time.monotonic()
-        # Remote-runner admission: the driver publishes the executor config
-        # here when pool="remote"; None rejects JOINs (local pools).
-        self.join_info: Optional[Dict[str, Any]] = None
-        self._join_lock = threading.Lock()
-        self._next_join_pid = 0
-        self._issued_pids: set = set()
         super().__init__(num_executors, secret)
 
     def attach_driver(self, driver) -> None:
@@ -361,48 +414,7 @@ class OptimizationServer(Server):
             FINAL=self._final,
             GET=self._get,
             LOG=self._log,
-            JOIN=self._join,
         )
-
-    def _join(self, msg):
-        """Admit a remote runner agent: assign it a partition id and ship
-        the executor config (exp_dir, hb_interval, ...). The DCN analogue of
-        Spark handing a partition to an executor — but pull, not push: agents
-        on other hosts dial in with the shared secret."""
-        info = self.join_info
-        if info is None:
-            return {"type": "ERR",
-                    "error": "this experiment does not accept remote runners"}
-        want = msg.get("partition_id")
-        with self._join_lock:
-            if want is not None and int(want) >= 0:
-                # Explicit pid: a restarted agent resuming its slot (its REG
-                # will take the re-registration BLACK path). Refuse slots
-                # outside the experiment and slots whose holder is still
-                # alive — two agents sharing a pid would interleave GET/
-                # FINAL and corrupt trial bookkeeping.
-                pid = int(want)
-                if pid >= self.num_executors:
-                    return {"type": "ERR",
-                            "error": "partition_id {} out of range (experiment "
-                                     "has {} slots)".format(pid, self.num_executors)}
-                rec = self.reservations.get(pid)
-                liveness = self.hb_loss_timeout or 10.0
-                if rec is not None and not rec.get("released") and \
-                        time.monotonic() - rec.get("last_beat", 0) < liveness:
-                    return {"type": "ERR",
-                            "error": "slot {} is held by a live runner".format(pid)}
-                self._issued_pids.add(pid)
-            else:
-                taken = set(self.reservations.all()) | self._issued_pids
-                while self._next_join_pid in taken:
-                    self._next_join_pid += 1
-                if self._next_join_pid >= self.num_executors:
-                    return {"type": "ERR", "error": "experiment full"}
-                pid = self._next_join_pid
-                self._issued_pids.add(pid)
-                self._next_join_pid += 1
-        return {"type": "JOIN", "partition_id": pid, **info}
 
     def _tick(self) -> None:
         if self.hb_loss_timeout is None or self.driver is None:
@@ -482,6 +494,7 @@ class DistributedServer(Server):
 
     def __init__(self, num_executors: int, secret: Optional[str] = None):
         self.driver = None
+        self._last_loss_scan = time.monotonic()
         super().__init__(num_executors, secret)
 
     def attach_driver(self, driver) -> None:
@@ -505,14 +518,33 @@ class DistributedServer(Server):
         return {"type": "OK"}
 
     def _metric(self, msg):
+        self.reservations.touch(msg["partition_id"])
         if self.driver is not None:
             self.driver.enqueue(dict(msg))
         return {"type": "OK"}
 
     def _final(self, msg):
+        # FINAL is a dist worker's last message — it never polls GET/GSTOP,
+        # so release its slot here for the remote pool's teardown ack.
+        self.reservations.touch(msg["partition_id"])
+        self.reservations.mark_released(msg["partition_id"])
         if self.driver is not None:
             self.driver.enqueue(dict(msg))
         return {"type": "OK"}
+
+    def _tick(self) -> None:
+        """An SPMD worker whose heartbeats stopped is dead, and a dead rank
+        wedges every collective in the world — surface it instead of letting
+        the experiment (and a remote pool's completion wait) hang forever."""
+        if self.hb_loss_timeout is None or self.driver is None:
+            return
+        now = time.monotonic()
+        if now - self._last_loss_scan < min(1.0, self.hb_loss_timeout / 4):
+            return
+        self._last_loss_scan = now
+        for pid in self.reservations.silent(self.hb_loss_timeout):
+            self.reservations.mark_released(pid)
+            self.driver.enqueue({"type": "DEAD_WORKER", "partition_id": pid})
 
     def _dist_config(self, msg):
         rec = self.reservations.get(0)

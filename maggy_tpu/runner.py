@@ -102,20 +102,45 @@ def run_agent(
     train_fn: Callable,
     partition_id: Optional[int] = None,
     profile: bool = False,
+    config_factory: Optional[Callable] = None,
 ) -> int:
-    """Join the experiment and run the trial-executor loop to completion.
+    """Join the experiment and run the matching executor loop to completion
+    — the trial loop for HPO experiments, or one SPMD worker of the training
+    world for distributed experiments (the JOIN reply's trial_type decides).
     Returns the partition id served."""
     info = join_experiment(driver_addr, secret, partition_id)
-    executor = TrialExecutor(
-        server_addr=driver_addr,
-        secret=secret,
-        hb_interval=info["hb_interval"],
-        exp_dir=info["exp_dir"],
-        optimization_key=info["optimization_key"],
-        train_fn=train_fn,
-        trial_type=info.get("trial_type", "optimization"),
-        profile=profile,
-    )
+    if info.get("trial_type") == "distributed":
+        from maggy_tpu.config import DistributedConfig
+        from maggy_tpu.core.executors.dist_executor import DistExecutor
+
+        # Model/dataset objects cannot travel over the wire; a config
+        # factory builds them locally. Without one, mesh/strategy come from
+        # the JOIN reply and the train_fn sees only the sharding_env.
+        config = config_factory() if config_factory else DistributedConfig(
+            num_workers=info["num_workers"],
+            mesh_shape=info.get("mesh_shape") or {},
+            strategy=info.get("strategy", "dp"),
+        )
+        executor = DistExecutor(
+            server_addr=driver_addr,
+            secret=secret,
+            hb_interval=info["hb_interval"],
+            exp_dir=info["exp_dir"],
+            train_fn=train_fn,
+            config=config,
+            num_workers=info["num_workers"],
+        )
+    else:
+        executor = TrialExecutor(
+            server_addr=driver_addr,
+            secret=secret,
+            hb_interval=info["hb_interval"],
+            exp_dir=info["exp_dir"],
+            optimization_key=info["optimization_key"],
+            train_fn=train_fn,
+            trial_type=info.get("trial_type", "optimization"),
+            profile=profile,
+        )
     executor(info["partition_id"])
     return info["partition_id"]
 
@@ -132,6 +157,10 @@ def main(argv=None) -> int:
     p.add_argument("--secret-file", help="file containing the shared secret")
     p.add_argument("--train", required=True,
                    help="train function as 'package.module:function'")
+    p.add_argument("--config",
+                   help="for distributed experiments: a zero-arg factory "
+                        "'package.module:function' returning the local "
+                        "DistributedConfig (model/datasets built on the agent)")
     p.add_argument("--partition-id", type=int, default=None,
                    help="reclaim a specific runner slot (restart recovery)")
     p.add_argument("--profile", action="store_true",
@@ -156,8 +185,10 @@ def main(argv=None) -> int:
         p.error("one of --ticket or --driver is required")
 
     train_fn = load_train_fn(args.train)
+    config_factory = load_train_fn(args.config) if args.config else None
     pid = run_agent(addr, secret, train_fn,
-                    partition_id=args.partition_id, profile=args.profile)
+                    partition_id=args.partition_id, profile=args.profile,
+                    config_factory=config_factory)
     print("runner {} done".format(pid))
     return 0
 

@@ -146,3 +146,19 @@ def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
         return cache_dir
     except Exception:  # noqa: BLE001 - cache is an optimization, never fatal
         return None
+
+
+def apply_platform_env() -> None:
+    """Make JAX_PLATFORMS authoritative even when a TPU PJRT plugin was
+    registered before this process's env vars could win (sitecustomize
+    imports jax at interpreter start on some images): backend choice
+    freezes at first use, so force the live config before any jax call."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    except Exception:  # noqa: BLE001 - never fatal; jax may be absent
+        pass

@@ -10,3 +10,22 @@ def train_fn(lr, units, reporter=None):
     if reporter is not None:
         reporter.broadcast(acc, step=0)
     return {"metric": acc}
+
+
+def dist_train_fn(sharding_env, reporter=None):
+    """One SPMD worker: proves the cross-process world actually formed and
+    that a collective runs over it."""
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.process_count() == sharding_env.process_count, \
+        "world did not form: {} != {}".format(
+            jax.process_count(), sharding_env.process_count)
+    # A real cross-process collective: global sum of one unit per device.
+    from jax.experimental import multihost_utils
+
+    total = multihost_utils.process_allgather(
+        jnp.ones(()) * (sharding_env.process_index + 1)).sum()
+    if reporter is not None:
+        reporter.broadcast(float(total), step=0)
+    return {"metric": float(jax.process_index())}

@@ -117,3 +117,59 @@ class TestDistributedE2E:
         assert result["num_workers"] == 2
         assert len(result["per_worker"]) == 2
         assert max(result["per_worker"]) < 1e-3
+
+
+def failing_rank1_train_fn(sharding_env, reporter=None):
+    if sharding_env.process_index == 1:
+        raise RuntimeError("rank 1 exploded")
+    return {"metric": 0.0}
+
+
+class TestDistributedFailures:
+    def test_failed_worker_fails_the_experiment(self, local_env):
+        """A failed rank must not produce a FINISHED result with a partial
+        average (its FINAL carries error=True)."""
+        config = DistributedConfig(
+            name="dp_fail", num_workers=2, mesh_shape={"data": 8},
+            hb_interval=0.05, backend="thread",
+        )
+        with pytest.raises(RuntimeError, match="1 of 2 distributed workers"):
+            experiment.lagom(failing_rank1_train_fn, config)
+
+    def test_silent_worker_detected_as_dead(self):
+        """Server-side: a registered dist worker that stops heartbeating is
+        reported as DEAD_WORKER (a dead rank wedges the SPMD world)."""
+        import time
+
+        from maggy_tpu.core.rpc import Client, DistributedServer
+
+        class FakeDriver:
+            def __init__(self):
+                self.messages = []
+                self.experiment_done = False
+
+            def enqueue(self, msg):
+                self.messages.append(msg)
+
+            def progress_snapshot(self):
+                return {}
+
+        driver = FakeDriver()
+        server = DistributedServer(num_executors=2)
+        server.attach_driver(driver)
+        server.hb_loss_timeout = 0.5
+        addr = server.start()
+        try:
+            client = Client(addr, 0, 0, 10.0, server.secret_hex)
+            client.register(host_port="h:1")
+            client.stop()  # dies silently: no heartbeats, no FINAL
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if any(m["type"] == "DEAD_WORKER" and m["partition_id"] == 0
+                       for m in driver.messages):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("DEAD_WORKER never enqueued")
+        finally:
+            server.stop()
