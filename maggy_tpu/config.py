@@ -24,6 +24,9 @@ class LagomConfig:
     name: str = "maggyTpuExperiment"
     description: str = ""
     hb_interval: float = constants.DEFAULT_HEARTBEAT_INTERVAL_S
+    #: Print a live progress line while the experiment runs (the reference
+    #: streams a progress bar to Jupyter, `util.py:71-86`).
+    verbose: bool = False
 
 
 @dataclass
@@ -122,6 +125,8 @@ class DistributedConfig(LagomConfig):
     #: (the experiment fails — a dead SPMD rank wedges the world).
     #: None -> max(HEARTBEAT_LOSS_MIN_S, hb_interval * HEARTBEAT_LOSS_FACTOR).
     hb_loss_timeout: Optional[float] = None
+    #: Capture a jax.profiler trace per worker into the experiment dir.
+    profile: bool = False
     experiment_dir: Optional[str] = None
 
     def __post_init__(self):

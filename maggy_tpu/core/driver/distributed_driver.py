@@ -91,6 +91,12 @@ class DistributedDriver(Driver):
             "Distributed worker {} stopped heartbeating; a dead rank wedges "
             "the SPMD world, aborting the experiment.".format(msg["partition_id"]))
         self.experiment_done = True
+        # Local pools block joining workers that may be wedged in a
+        # collective with the dead rank — tear them down so run_experiment
+        # can surface the exception.
+        pool = getattr(self, "_active_pool", None)
+        if pool is not None:
+            pool.terminate()
 
     def _log_msg_callback(self, msg) -> None:
         self.add_executor_logs(msg.get("logs"))
@@ -99,14 +105,17 @@ class DistributedDriver(Driver):
         self.add_executor_logs(msg.get("logs"))
         with self._results_lock:
             self._finals += 1
-            done = self._finals >= self.num_workers
+            # Fail fast on the FIRST errored rank: a failed worker dooms the
+            # SPMD world, so waiting for the rest (who may be wedged in a
+            # collective) only delays the inevitable FAILED verdict.
+            done = self._finals >= self.num_workers or bool(msg.get("error"))
             if msg.get("error"):
                 self._worker_errors += 1
             elif msg.get("value") is not None:
                 self.results.append(float(msg["value"]))
         if done:
-            # All workers reported: lets the remote pool stop waiting (local
-            # pools end when their worker processes return).
+            # Lets the remote pool stop waiting (local pools end when their
+            # worker processes return).
             self.experiment_done = True
 
     def _exp_startup_callback(self) -> None:
@@ -136,4 +145,4 @@ class DistributedDriver(Driver):
 
     def progress_snapshot(self) -> Dict[str, Any]:
         with self._results_lock:
-            return {"workers_done": len(self.results), "num_workers": self.num_workers}
+            return {"workers_done": self._finals, "num_workers": self.num_workers}

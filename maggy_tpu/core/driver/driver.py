@@ -96,6 +96,7 @@ class Driver(ABC):
             self._exp_startup_callback()
             self.init()
             pool = self._make_runner_pool()
+            self._active_pool = pool
             # Fan out the executor wrapper to all runners; BLOCKS until all
             # workers return (the reference's foreachPartition semantics).
             failures = pool.run(self._executor_fn(train_fn)) or []
@@ -128,6 +129,25 @@ class Driver(ABC):
         self.server_addr = self.env.connect_host(
             self.server, host=getattr(self.config, "bind_host", None))
         self._start_worker()
+        if getattr(self.config, "verbose", False):
+            self._start_progress_printer()
+
+    def _start_progress_printer(self) -> None:
+        """Live progress line on stdout (the reference's Jupyter progress
+        bar, `util.py:71-86`); remote observers use `maggy_tpu.monitor`."""
+        from maggy_tpu import monitor
+
+        def printer():
+            last = None
+            while not self.worker_done:
+                line = monitor.render(self.progress_snapshot())
+                if line != last:
+                    print("[{}] {}".format(self.name, line), flush=True)
+                    last = line
+                time.sleep(1.0)
+
+        threading.Thread(target=printer, daemon=True,
+                         name="progress-printer").start()
 
     def _start_worker(self) -> None:
         def worker():

@@ -39,6 +39,7 @@ class DistExecutor:
         train_fn: Callable,
         config,
         num_workers: int,
+        profile: bool = False,
     ):
         self.server_addr = server_addr
         self.secret = secret
@@ -47,6 +48,7 @@ class DistExecutor:
         self.train_fn = train_fn
         self.config = config
         self.num_workers = num_workers
+        self.profile = profile or bool(getattr(config, "profile", False))
 
     def __call__(self, partition_id: int) -> None:
         env = EnvSing.get_instance()
@@ -70,7 +72,14 @@ class DistExecutor:
             dist_config = client.get_dist_config()
 
             sharding_env = self._init_cluster(dist_config, partition_id, reporter)
-            metric = self._run_train_fn(sharding_env, reporter)
+            if self.profile:
+                import jax
+
+                logdir = "{}/tensorboard_worker{}".format(self.exp_dir, partition_id)
+                with jax.profiler.trace(logdir):
+                    metric = self._run_train_fn(sharding_env, reporter)
+            else:
+                metric = self._run_train_fn(sharding_env, reporter)
             client.finalize_metric(metric, reporter)
         except Exception:  # noqa: BLE001
             reporter.log("Distributed worker {} failed:\n{}".format(

@@ -111,28 +111,29 @@ class Reservations:
             if rec is not None:
                 rec["last_beat"] = time.monotonic()
 
-    def lost_assignments(self, timeout: float):
-        """Partitions holding a trial but silent for longer than ``timeout``:
-        [(partition_id, trial_id)]. Read-only; the caller decides recovery."""
+    def _silent_locked(self, timeout: float):
         now = time.monotonic()
-        with self.lock:
-            return [
-                (pid, rec["trial_id"])
-                for pid, rec in self._table.items()
-                if rec.get("trial_id") is not None
-                and now - rec.get("last_beat", now) > timeout
-            ]
+        return [
+            pid for pid, rec in self._table.items()
+            if not rec.get("released")
+            and now - rec.get("last_beat", now) > timeout
+        ]
 
     def silent(self, timeout: float):
         """Registered, unreleased partitions silent for longer than
         ``timeout`` — regardless of trial assignment (distributed workers
         hold no trials but must heartbeat for their whole run)."""
-        now = time.monotonic()
+        with self.lock:
+            return self._silent_locked(timeout)
+
+    def lost_assignments(self, timeout: float):
+        """Silent partitions that hold a trial: [(partition_id, trial_id)].
+        Read-only; the caller decides recovery."""
         with self.lock:
             return [
-                pid for pid, rec in self._table.items()
-                if not rec.get("released")
-                and now - rec.get("last_beat", now) > timeout
+                (pid, self._table[pid]["trial_id"])
+                for pid in self._silent_locked(timeout)
+                if self._table[pid].get("trial_id") is not None
             ]
 
     def get(self, partition_id: int) -> Optional[Dict[str, Any]]:

@@ -40,6 +40,12 @@ class RunnerPool(ABC):
         detection) and only escalates if the experiment could not complete.
         """
 
+    def terminate(self) -> None:
+        """Force-stop all workers (best effort). Used when the experiment is
+        already doomed (e.g. a dead SPMD rank) and surviving workers may be
+        wedged waiting on it. Threads cannot be killed — only process-backed
+        pools act on this."""
+
 
 class ThreadRunnerPool(RunnerPool):
     def run(self, worker_fn: Callable[[int], None]) -> List[BaseException]:
@@ -81,6 +87,12 @@ class ProcessRunnerPool(RunnerPool):
         super().__init__(num_workers)
         self.start_method = start_method
         self.chip_env_fn = chip_env_fn
+        self._procs: list = []
+
+    def terminate(self) -> None:
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
 
     def run(self, worker_fn: Callable[[int], None]) -> List[BaseException]:
         ctx = mp.get_context(self.start_method)
@@ -91,6 +103,7 @@ class ProcessRunnerPool(RunnerPool):
                             name="runner-{}".format(i))
             p.start()
             procs.append(p)
+        self._procs = procs
         failures: List[BaseException] = []
         for p in procs:
             p.join()
@@ -170,11 +183,18 @@ class RemoteRunnerPool(RunnerPool):
         drv = self.driver
         drv.env.dump(json.dumps(self.ticket(), indent=2),
                      drv.exp_dir + "/runner_ticket.json")
+        # Trial parallelism proceeds with however many agents join;
+        # distributed training NEEDS the full world before anything runs.
+        need_all = (drv.server.join_info or {}).get("trial_type") == "distributed"
         deadline = time.monotonic() + constants.REGISTRATION_TIMEOUT_S
-        while not drv.server.reservations.all():
+        while not drv.experiment_done:
+            reservations = drv.server.reservations
+            if reservations.done() if need_all else bool(reservations.all()):
+                break
             if time.monotonic() > deadline:
                 raise TimeoutError(
-                    "No remote runner joined within {}s; ticket at {}".format(
+                    "{} remote runner(s) missing after {}s; ticket at {}".format(
+                        reservations.remaining() if need_all else "All",
                         constants.REGISTRATION_TIMEOUT_S,
                         drv.exp_dir + "/runner_ticket.json"))
             time.sleep(0.2)

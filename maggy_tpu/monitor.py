@@ -1,0 +1,99 @@
+"""Experiment monitor: ``python -m maggy_tpu.monitor``.
+
+The reference streams progress to Jupyter by having sparkmagic poll the
+driver's LOG message (`rpc.py:369-377`, `driver.py:167-175`). The TPU-native
+equivalent is this standalone watcher: it polls the same LOG RPC over the
+control plane — from any machine that can reach the driver — and renders a
+progress snapshot, so long sweeps can be observed without attaching to the
+driver process.
+
+    python -m maggy_tpu.monitor --ticket /shared/exp_dir/runner_ticket.json
+    python -m maggy_tpu.monitor --driver 10.0.0.2:41234 --secret-file s.txt --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+import time
+from typing import Any, Dict, Tuple
+
+from maggy_tpu import util
+from maggy_tpu.core.rpc import MessageSocket
+
+
+def poll_progress(addr: Tuple[str, int], secret: str,
+                  timeout: float = 10.0) -> Dict[str, Any]:
+    """One LOG round trip: the driver's live progress snapshot."""
+    key = secret.encode() if isinstance(secret, str) else secret
+    sock = socket.create_connection(addr, timeout=timeout)
+    try:
+        MessageSocket.send_msg(sock, {"type": "LOG"}, key)
+        return MessageSocket.recv_msg(sock, key)
+    finally:
+        sock.close()
+
+
+def render(snap: Dict[str, Any]) -> str:
+    if "num_trials" in snap:  # HPO / ablation experiment
+        done = snap.get("finalized", 0)
+        total = snap.get("num_trials", 0)
+        parts = [util.progress_bar(done, total)]
+        if snap.get("best_val") is not None:
+            parts.append("best={:.6g}".format(snap["best_val"]))
+        if snap.get("early_stopped"):
+            parts.append("early_stopped={}".format(snap["early_stopped"]))
+        return " ".join(parts)
+    if "num_workers" in snap:  # distributed training
+        return util.progress_bar(snap.get("workers_done", 0),
+                                 snap.get("num_workers", 0)) + " workers done"
+    return str({k: v for k, v in snap.items() if k != "type"})
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="maggy_tpu.monitor", description="Watch a running experiment.")
+    p.add_argument("--ticket", help="path to the driver's runner_ticket.json")
+    p.add_argument("--driver", help="driver control-plane address HOST:PORT")
+    p.add_argument("--secret", help="shared experiment secret (hex)")
+    p.add_argument("--secret-file", help="file containing the shared secret")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between polls (default 2)")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit")
+    args = p.parse_args(argv)
+
+    if args.ticket:
+        from maggy_tpu.runner import read_ticket
+
+        ticket = read_ticket(args.ticket, wait_s=0)
+        addr = (ticket["host"], int(ticket["port"]))
+        secret = ticket["secret"]
+    elif args.driver:
+        host, _, port = args.driver.rpartition(":")
+        addr = (host, int(port))
+        if args.secret_file:
+            with open(args.secret_file) as f:
+                secret = f.read().strip()
+        elif args.secret:
+            secret = args.secret
+        else:
+            p.error("--driver requires --secret or --secret-file")
+    else:
+        p.error("one of --ticket or --driver is required")
+
+    while True:
+        try:
+            snap = poll_progress(addr, secret)
+        except (ConnectionError, socket.timeout, OSError):
+            print("experiment finished (driver gone)")
+            return 0
+        print(render(snap), flush=True)
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

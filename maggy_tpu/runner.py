@@ -129,6 +129,7 @@ def run_agent(
             train_fn=train_fn,
             config=config,
             num_workers=info["num_workers"],
+            profile=profile,
         )
     else:
         executor = TrialExecutor(

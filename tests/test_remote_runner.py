@@ -176,3 +176,39 @@ class TestRemoteDistributedE2E:
         # metric = process_index per worker -> average 0.5 proves both
         # ranks reported through the control plane.
         assert result["average_metric"] == 0.5
+
+
+class TestMonitor:
+    def test_poll_and_render(self, capsys):
+        from maggy_tpu import monitor
+
+        class FakeDriver:
+            experiment_done = False
+
+            def enqueue(self, msg):
+                pass
+
+            def get_trial(self, tid):
+                return None
+
+            def progress_snapshot(self):
+                return {"num_trials": 10, "finalized": 4, "best_val": 0.93,
+                        "early_stopped": 1}
+
+        server = OptimizationServer(num_executors=1)
+        server.attach_driver(FakeDriver())
+        addr = server.start()
+        try:
+            snap = monitor.poll_progress(addr, server.secret_hex)
+            assert snap["finalized"] == 4
+            line = monitor.render(snap)
+            assert "4/10" in line and "best=0.93" in line
+            assert "early_stopped=1" in line
+        finally:
+            server.stop()
+
+    def test_render_distributed(self):
+        from maggy_tpu import monitor
+
+        line = monitor.render({"num_workers": 4, "workers_done": 2})
+        assert "2/4" in line and "workers" in line
