@@ -117,6 +117,13 @@ class DistributedDriver(Driver):
             # Lets the remote pool stop waiting (local pools end when their
             # worker processes return).
             self.experiment_done = True
+        if msg.get("error"):
+            # Surviving ranks may be wedged in a collective with the failed
+            # one; tear the local pool down so run_experiment can fail fast
+            # (remote agents notice via their own collective timeouts).
+            pool = getattr(self, "_active_pool", None)
+            if pool is not None:
+                pool.terminate()
 
     def _exp_startup_callback(self) -> None:
         self.job_start = time.time()

@@ -37,6 +37,9 @@ def lagom(train_fn: Callable, config: LagomConfig) -> Any:
     global APP_ID, RUNNING, RUN_ID
     if RUNNING:
         raise RuntimeError("An experiment is already running in this process.")
+    # Honor JAX_PLATFORMS even when a TPU plugin was registered before this
+    # process's env could win (see util.apply_platform_env).
+    util.apply_platform_env()
     env = EnvSing.get_instance()
     if APP_ID is None:
         APP_ID = os.environ.get("MAGGY_TPU_APP_ID",

@@ -83,12 +83,19 @@ def main(argv=None) -> int:
     else:
         p.error("one of --ticket or --driver is required")
 
+    polled_ok = False
     while True:
         try:
             snap = poll_progress(addr, secret)
-        except (ConnectionError, socket.timeout, OSError):
-            print("experiment finished (driver gone)")
-            return 0
+        except (ConnectionError, socket.timeout, OSError) as e:
+            if polled_ok:
+                # The driver served us before and is now gone: finished.
+                print("experiment finished (driver gone)")
+                return 0
+            print("cannot reach driver at {}:{}: {}".format(addr[0], addr[1], e),
+                  file=sys.stderr)
+            return 1
+        polled_ok = True
         print(render(snap), flush=True)
         if args.once:
             return 0

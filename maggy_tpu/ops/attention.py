@@ -13,8 +13,10 @@ TPU-first hot-op design the BERT/Llama baseline configs need:
   dispatches to the kernel when shapes tile cleanly on a TPU backend.
 
 Kernel layout follows the pallas guide (/opt/skills/guides/pallas_guide.md):
-grid = (B*H, Sq/BLK_Q), K/V streamed block-by-block with `fori_loop`,
-(8,128)-aligned tiles, `preferred_element_type=float32` on every MXU dot.
+grid = (B*H, Sq/BLK_Q, Sk/BLK_K) with the k-block dimension sequential
+("arbitrary") and the online-softmax state in persistent VMEM scratch, so
+VMEM holds one K/V tile at a time (long-context capable); (8,128)-aligned
+tiles, `preferred_element_type=float32` on every MXU dot.
 """
 
 from __future__ import annotations
@@ -54,55 +56,69 @@ def attention_reference(q, k, v, causal: bool = True, mask=None):
 # -------------------------------------------------------------- pallas kernel
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_k, seq_k,
-                      causal, sm_scale):
-    """One (batch*head, q-block) program: stream K/V blocks, online softmax.
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *, causal, sm_scale):
+    """One (batch*head, q-block, k-block) program: K/V stream through the
+    grid's innermost (sequential) dimension, so VMEM holds only one
+    [blk_k, D] tile of K and V at a time — sequence length is bounded by
+    HBM, not VMEM. Online-softmax state (acc, running max, running sum)
+    lives in VMEM scratch that persists across the k-block iterations of
+    each (bh, qi) program group.
 
-    Refs: q [BLK_Q, D]; k/v [Sk, D] (full K/V for this head in VMEM);
-    o [BLK_Q, D]; lse [BLK_Q, 128] (lane-padded).
+    Refs: q [BLK_Q, D]; k/v [BLK_K, D]; o [BLK_Q, D]; lse [BLK_Q, 128]
+    (lane-padded); scratch acc [BLK_Q, D], m/l [BLK_Q, 128] fp32.
     """
     from jax.experimental import pallas as pl
 
     blk_q = q_ref.shape[0]
-    d = q_ref.shape[1]
+    blk_k = k_ref.shape[0]
     qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * sm_scale
+    kb = pl.program_id(2)
+    num_kb = pl.num_programs(2)
 
-    num_kb = seq_k // blk_k
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
 
-    def body(kb, carry):
-        acc, m_i, l_i = carry
-        k_blk = k_ref[pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+    def contribute():
+        q = q_ref[:].astype(jnp.float32) * sm_scale
+        k_blk = k_ref[:].astype(jnp.float32)
+        v_blk = v_ref[:].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
             q_pos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
             k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_i - m_new)
-        l_new = alpha * l_i + jnp.sum(p, axis=1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
-    acc0 = jnp.zeros((blk_q, d), jnp.float32)
-    m0 = jnp.full((blk_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((blk_q,), jnp.float32)
     if causal:
-        # Only K blocks at or before this Q block's diagonal contribute.
-        last_kb = jnp.minimum(((qi + 1) * blk_q + blk_k - 1) // blk_k, num_kb)
-        acc, m_i, l_i = jax.lax.fori_loop(0, last_kb, body, (acc0, m0, l0))
+        # Blocks entirely above the diagonal contribute nothing — skip the
+        # compute (the tile fetch still happens; cheap next to the MXU work).
+        @pl.when(kb * blk_k < (qi + 1) * blk_q)
+        def _():
+            contribute()
     else:
-        acc, m_i, l_i = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+        contribute()
 
-    l_safe = jnp.maximum(l_i, 1e-30)
-    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse = (m_i + jnp.log(l_safe))
-    lse_ref[:] = jnp.broadcast_to(lse[:, None], lse_ref.shape)
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[:] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse = m_ref[:, 0] + jnp.log(l_safe)
+        lse_ref[:] = jnp.broadcast_to(lse[:, None], lse_ref.shape)
 
 
 def _flash_fwd(q, k, v, causal: bool, blk_q: int, blk_k: int, interpret: bool):
@@ -113,25 +129,36 @@ def _flash_fwd(q, k, v, causal: bool, blk_q: int, blk_k: int, interpret: bool):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     sm_scale = 1.0 / (D ** 0.5)
-    grid = (BH, Sq // blk_q)
-    kernel = functools.partial(_flash_fwd_kernel, blk_k=blk_k, seq_k=Sk,
-                               causal=causal, sm_scale=sm_scale)
+    grid = (BH, Sq // blk_q, Sk // blk_k)
+    kernel = functools.partial(_flash_fwd_kernel, causal=causal,
+                               sm_scale=sm_scale)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, blk_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, Sk, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, Sk, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, blk_q, D), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((None, blk_k, D), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, blk_k, D), lambda bh, qi, kb: (bh, kb, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, blk_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, blk_q, 128), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, blk_q, D), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((None, blk_q, 128), lambda bh, qi, kb: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
             jax.ShapeDtypeStruct((BH, Sq, 128), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, D), jnp.float32),
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            # bh/qi programs are independent (megacore-splittable); the
+            # k-block dimension carries the online-softmax accumulation and
+            # must run sequentially.
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(q, k, v)
     return out, lse[:, :, 0]

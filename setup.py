@@ -26,4 +26,10 @@ setup(
         "tensorboard": ["torch", "tensorboard"],
         "gcs": ["gcsfs"],
     },
+    entry_points={
+        "console_scripts": [
+            "maggy-tpu-runner = maggy_tpu.runner:main",
+            "maggy-tpu-monitor = maggy_tpu.monitor:main",
+        ],
+    },
 )
