@@ -91,9 +91,11 @@ class DistributedDriver(Driver):
             "Distributed worker {} stopped heartbeating; a dead rank wedges "
             "the SPMD world, aborting the experiment.".format(msg["partition_id"]))
         self.experiment_done = True
-        # Local pools block joining workers that may be wedged in a
-        # collective with the dead rank — tear them down so run_experiment
-        # can surface the exception.
+        self._terminate_active_pool()
+
+    def _terminate_active_pool(self) -> None:
+        """Tear down local worker processes: survivors of a failed/dead rank
+        may be wedged in a collective and would block run_experiment."""
         pool = getattr(self, "_active_pool", None)
         if pool is not None:
             pool.terminate()
@@ -118,12 +120,9 @@ class DistributedDriver(Driver):
             # worker processes return).
             self.experiment_done = True
         if msg.get("error"):
-            # Surviving ranks may be wedged in a collective with the failed
-            # one; tear the local pool down so run_experiment can fail fast
-            # (remote agents notice via their own collective timeouts).
-            pool = getattr(self, "_active_pool", None)
-            if pool is not None:
-                pool.terminate()
+            # Fail fast (remote agents notice via their own collective
+            # timeouts).
+            self._terminate_active_pool()
 
     def _exp_startup_callback(self) -> None:
         self.job_start = time.time()

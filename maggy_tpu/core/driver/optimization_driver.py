@@ -395,6 +395,15 @@ class OptimizationDriver(Driver):
         self.result["duration_s"] = duration
         self.env.dump(json.dumps(self.result, indent=2, default=str),
                       self.exp_dir + "/result.json")
+        # Aggregate per-trial artifacts (.hparams.json/.outputs.json) into
+        # .summary.json (reference `util.py:126-148`).
+        try:
+            util.build_summary(self.exp_dir, env=self.env)
+        except Exception:  # noqa: BLE001 - summary is best-effort
+            pass
+        self.maggy_log = self._result_summary(duration)
+        if getattr(self.config, "verbose", False):
+            print(self.maggy_log, flush=True)
         self.env.finalize_experiment(
             self.exp_dir, "FINISHED",
             {"result": {k: self.result[k] for k in
@@ -405,6 +414,24 @@ class OptimizationDriver(Driver):
     def _exp_exception_callback(self, exc) -> None:
         self.env.finalize_experiment(self.exp_dir, "FAILED", {"error": repr(exc)})
         raise exc
+
+    def _result_summary(self, duration: float) -> str:
+        """Human-readable final summary (the reference prints one to the
+        notebook, `optimization_driver.py:172-194`)."""
+        r = self.result
+        lines = [
+            "------ {} results ------ direction({})".format(
+                type(self.controller).__name__, self.direction),
+            "BEST combination {} -- metric {}".format(
+                json.dumps(r["best_hp"], default=str), r["best_val"]),
+            "WORST combination {} -- metric {}".format(
+                json.dumps(r["worst_hp"], default=str), r["worst_val"]),
+            "AVERAGE metric -- {}".format(r["avg"]),
+            "EARLY STOPPED trials -- {}".format(r["early_stopped"]),
+            "Total job time {:.2f} s ({} trials)".format(
+                duration, r["num_trials"]),
+        ]
+        return "\n".join(lines)
 
     def progress_snapshot(self) -> Dict[str, Any]:
         with self._store_lock:

@@ -84,17 +84,24 @@ def main(argv=None) -> int:
         p.error("one of --ticket or --driver is required")
 
     polled_ok = False
+    consecutive_failures = 0
     while True:
         try:
             snap = poll_progress(addr, secret)
         except (ConnectionError, socket.timeout, OSError) as e:
-            if polled_ok:
-                # The driver served us before and is now gone: finished.
+            if not polled_ok:
+                print("cannot reach driver at {}:{}: {}".format(
+                    addr[0], addr[1], e), file=sys.stderr)
+                return 1
+            # Distinguish a transient blip (driver briefly saturated) from a
+            # finished experiment: require a few consecutive failures.
+            consecutive_failures += 1
+            if consecutive_failures >= 3:
                 print("experiment finished (driver gone)")
                 return 0
-            print("cannot reach driver at {}:{}: {}".format(addr[0], addr[1], e),
-                  file=sys.stderr)
-            return 1
+            time.sleep(args.interval)
+            continue
+        consecutive_failures = 0
         polled_ok = True
         print(render(snap), flush=True)
         if args.once:

@@ -11,8 +11,10 @@ overlaps compute under XLA's async collectives.
 Causal masking is block-aware: a device skips K/V shards strictly in its
 future; the diagonal shard applies the intra-block triangular mask.
 Implemented with `shard_map` so it runs identically on a CPU test mesh and a
-TPU pod; the per-shard inner attention reuses the Pallas flash kernel when
-shapes tile (ops/attention.py).
+TPU pod. The per-(shard x shard) inner attention is plain XLA (scores are
+[S/n, S/n] per step — already n^2 smaller than full attention); swap in the
+Pallas flash kernel from ops/attention.py per block if per-device shards
+grow past VMEM-friendly sizes.
 """
 
 from __future__ import annotations

@@ -32,6 +32,7 @@ class ShardedBatchIterator:
         drop_remainder: bool = True,
         mesh=None,
         epochs: Optional[int] = 1,
+        prefetch: int = 0,
     ):
         if not data:
             raise ValueError("Empty dataset.")
@@ -50,6 +51,7 @@ class ShardedBatchIterator:
         self.drop_remainder = drop_remainder
         self.mesh = mesh
         self.epochs = epochs
+        self.prefetch = prefetch
 
     def _shard_indices(self, epoch: int) -> np.ndarray:
         idx = np.arange(self.n)
@@ -61,6 +63,13 @@ class ShardedBatchIterator:
         return idx[self.current_shard::self.shard_count]
 
     def __iter__(self) -> Iterator[Dict[str, Any]]:
+        it = self._generate()
+        # Gather + host->device copy run in a producer thread, `prefetch`
+        # batches ahead, so input staging overlaps the (async-dispatched)
+        # device compute of earlier steps.
+        return prefetch_iterator(it, self.prefetch) if self.prefetch > 0 else it
+
+    def _generate(self) -> Iterator[Dict[str, Any]]:
         epoch = 0
         while self.epochs is None or epoch < self.epochs:
             idx = self._shard_indices(epoch)
@@ -127,6 +136,56 @@ class ShardedBatchIterator:
         if not self.drop_remainder and per_shard % self.batch_size:
             full += 1
         return full * (self.epochs or 1)
+
+
+def prefetch_iterator(iterator, size: int = 2):
+    """Run ``iterator`` in a daemon producer thread, keeping up to ``size``
+    items staged. Producer exceptions re-raise at the consumer."""
+    import queue
+    import threading
+
+    if size < 1:
+        raise ValueError("prefetch size must be >= 1")
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    end = object()
+    errors: list = []
+    stop = threading.Event()
+
+    def producer():
+        try:
+            for item in iterator:
+                # Bounded put that watches for consumer abandonment: an
+                # early-stopped trial (EarlyStopException mid-epoch) drops
+                # the generator, and the producer must not stay blocked on
+                # a full queue forever.
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 - re-raised at consumer
+            errors.append(e)
+        finally:
+            try:
+                q.put_nowait(end)
+            except queue.Full:
+                pass
+
+    threading.Thread(target=producer, daemon=True,
+                     name="batch-prefetch").start()
+    try:
+        while True:
+            item = q.get()
+            if item is end:
+                if errors:
+                    raise errors[0]
+                return
+            yield item
+    finally:
+        stop.set()
 
 
 def load_path_dataset(path, columns=None, file_shard=None):

@@ -83,3 +83,54 @@ class TestFromPath:
         rows = np.concatenate([b["x"] for b in it])
         # Shard 1 of 2 over files [1::2] = parts 1 and 3 -> rows 10-19, 30-39.
         assert set(rows) == set(np.arange(10.0, 20)) | set(np.arange(30.0, 40))
+
+
+class TestPrefetch:
+    def test_prefetch_yields_identical_batches(self):
+        import numpy as np
+
+        from maggy_tpu.train.data import ShardedBatchIterator
+
+        data = {"x": np.arange(64).reshape(32, 2), "y": np.arange(32)}
+        plain = list(ShardedBatchIterator(data, batch_size=8, seed=3))
+        pre = list(ShardedBatchIterator(data, batch_size=8, seed=3, prefetch=2))
+        assert len(plain) == len(pre) == 4
+        for a, b in zip(plain, pre):
+            np.testing.assert_array_equal(a["x"], b["x"])
+            np.testing.assert_array_equal(a["y"], b["y"])
+
+    def test_prefetch_propagates_producer_errors(self):
+        from maggy_tpu.train.data import prefetch_iterator
+
+        def boom():
+            yield 1
+            raise RuntimeError("producer exploded")
+
+        it = prefetch_iterator(boom(), size=2)
+        assert next(it) == 1
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="producer exploded"):
+            list(it)
+
+    def test_abandoned_prefetch_unblocks_producer(self):
+        import threading
+        import time as _time
+
+        from maggy_tpu.train.data import prefetch_iterator
+
+        produced = []
+
+        def gen():
+            for i in range(1000):
+                produced.append(i)
+                yield i
+
+        it = prefetch_iterator(gen(), size=2)
+        next(it)
+        it.close()  # consumer abandons (e.g. EarlyStopException)
+        _time.sleep(0.5)
+        alive = [t for t in threading.enumerate()
+                 if t.name == "batch-prefetch" and t.is_alive()]
+        assert not alive, "producer thread leaked after abandonment"
+        assert len(produced) < 1000  # producer stopped early
