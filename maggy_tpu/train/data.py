@@ -169,10 +169,15 @@ def prefetch_iterator(iterator, size: int = 2):
         except BaseException as e:  # noqa: BLE001 - re-raised at consumer
             errors.append(e)
         finally:
-            try:
-                q.put_nowait(end)
-            except queue.Full:
-                pass
+            # The sentinel must actually arrive (a full queue would swallow
+            # put_nowait and leave the consumer blocked forever after it
+            # drains); same bounded stop-watching put as for items.
+            while not stop.is_set():
+                try:
+                    q.put(end, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     threading.Thread(target=producer, daemon=True,
                      name="batch-prefetch").start()

@@ -134,3 +134,32 @@ class TestPrefetch:
                  if t.name == "batch-prefetch" and t.is_alive()]
         assert not alive, "producer thread leaked after abandonment"
         assert len(produced) < 1000  # producer stopped early
+
+    def test_prefetch_sentinel_survives_full_queue(self):
+        import time as _time
+
+        from maggy_tpu.train.data import prefetch_iterator
+
+        # Producer finishes while both queue slots are full: the consumer
+        # must still receive every item and terminate (no hang on the
+        # dropped sentinel).
+        it = prefetch_iterator(iter(range(5)), size=2)
+        _time.sleep(0.5)  # let the producer fill the queue and finish
+        assert list(it) == [0, 1, 2, 3, 4]
+
+    def test_prefetch_error_after_full_queue_reraises(self):
+        import time as _time
+
+        import pytest as _pytest
+
+        from maggy_tpu.train.data import prefetch_iterator
+
+        def gen():
+            yield 1
+            yield 2
+            raise RuntimeError("late boom")
+
+        it = prefetch_iterator(gen(), size=2)
+        _time.sleep(0.5)
+        with _pytest.raises(RuntimeError, match="late boom"):
+            list(it)

@@ -66,3 +66,19 @@ class TestNativeCodec:
         assert native.frame_scan(buf, key, 1 << 20) == len(buf)
         assert native.hmac_sha256(key, b"m") == \
             hmac.new(key, b"m", hashlib.sha256).digest()
+
+    def test_frame_scan_fuzz_never_crashes(self):
+        """Untrusted bytes from the network must never crash the scanner:
+        any result other than a valid frame just drops the connection."""
+        import numpy as np
+
+        from maggy_tpu import native
+
+        rng = np.random.default_rng(0)
+        secret = b"k" * 16
+        for _ in range(300):
+            n = int(rng.integers(0, 200))
+            buf = bytearray(rng.integers(0, 256, size=n, dtype=np.uint8).tobytes())
+            result = native.frame_scan(buf, secret, 1 << 20)
+            assert isinstance(result, int)
+            assert result <= len(buf)
