@@ -3,10 +3,10 @@
 The reference framework has no attention code (SURVEY.md §5.7); this is the
 TPU-first hot-op design the BERT/Llama baseline configs need:
 
-- `flash_attention`: Pallas TPU kernel — tiled online-softmax forward, fp32
-  accumulators in VMEM scratch, causal block skipping via the grid, O(S)
-  memory. Backward is a flash-style recompute VJP (no S x S materialization
-  thanks to blockwise lax.map) — good enough until a Pallas bwd kernel lands.
+- `flash_attention`: Pallas TPU kernels — tiled online-softmax forward and
+  a two-kernel backward (dK/dV streaming Q tiles, dQ streaming K/V tiles),
+  fp32 accumulators in VMEM scratch, causal block skipping, O(tile) VMEM
+  and no S x S materialization in either direction.
 - `attention_reference`: straightforward XLA softmax attention (CPU tests,
   odd shapes).
 - `multi_head_attention`: public entry — handles GQA (kv-head repeat),
@@ -172,12 +172,23 @@ def flash_attention(q, k, v, causal: bool = True, blk_q: int = 128,
     return out
 
 
+def _to_bh3(x):
+    """[B,S,H,D] -> heads-major [B*H, S, D] (the kernels' layout)."""
+    B, S, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+
+def _from_bh3(x, B, H):
+    """[B*H, S, D] -> [B,S,H,D]."""
+    _, S, D = x.shape
+    return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
 def _flash_fwd_4d(q, k, v, causal, blk_q, blk_k, interpret):
     B, Sq, H, D = q.shape
-    to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)  # noqa: E731
-    out3, lse = _flash_fwd(to3(q), to3(k), to3(v), causal, blk_q, blk_k, interpret)
-    out = out3.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
-    return out, lse
+    out3, lse = _flash_fwd(_to_bh3(q), _to_bh3(k), _to_bh3(v), causal,
+                           blk_q, blk_k, interpret)
+    return _from_bh3(out3, B, H), lse
 
 
 def _flash_fwd_rule(q, k, v, causal, blk_q, blk_k, interpret):
@@ -185,46 +196,183 @@ def _flash_fwd_rule(q, k, v, causal, blk_q, blk_k, interpret):
     return out, (q, k, v, out, lse)
 
 
+def _recompute_p_ds(q, k_blk, v_blk, do, lse, delta, q_pos0, k_pos0,
+                    causal, sm_scale):
+    """Shared bwd block math: probabilities from the saved LSE, then the
+    softmax-transpose ds = p * (dO·Vᵀ - delta) * scale. All [blk_q, blk_k]."""
+    blk_q, blk_k = q.shape[0], k_blk.shape[0]
+    s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        q_pos = q_pos0 + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+        k_pos = k_pos0 + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * sm_scale
+    return p, ds
+
+
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc, *, causal, sm_scale):
+    """grid (BH, kb, qi): one K/V tile per program group; stream Q/dO tiles
+    through the sequential qi dimension, accumulating dK/dV in VMEM scratch."""
+    from jax.experimental import pallas as pl
+
+    blk_q = q_ref.shape[0]
+    blk_k = k_ref.shape[0]
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_qb = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def contribute():
+        q = q_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        p, ds = _recompute_p_ds(
+            q, k_ref[:].astype(jnp.float32), v_ref[:].astype(jnp.float32),
+            do, lse_ref[:, 0], delta_ref[:, 0],
+            qi * blk_q, kb * blk_k, causal, sm_scale)
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        # Q blocks strictly above this K tile's diagonal see none of it.
+        @pl.when((qi + 1) * blk_q > kb * blk_k)
+        def _():
+            contribute()
+    else:
+        contribute()
+
+    @pl.when(qi == num_qb - 1)
+    def _finalize():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, causal, sm_scale):
+    """grid (BH, qi, kb): one Q tile per program group; stream K/V tiles
+    through the sequential kb dimension, accumulating dQ in VMEM scratch."""
+    from jax.experimental import pallas as pl
+
+    blk_q = q_ref.shape[0]
+    blk_k = k_ref.shape[0]
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    num_kb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def contribute():
+        _, ds = _recompute_p_ds(
+            q_ref[:].astype(jnp.float32), k_ref[:].astype(jnp.float32),
+            v_ref[:].astype(jnp.float32), do_ref[:].astype(jnp.float32),
+            lse_ref[:, 0], delta_ref[:, 0],
+            qi * blk_q, kb * blk_k, causal, sm_scale)
+        dq_acc[:] += jax.lax.dot_general(ds, k_ref[:].astype(jnp.float32),
+                                         (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(kb * blk_k < (qi + 1) * blk_q)
+        def _():
+            contribute()
+    else:
+        contribute()
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        dq_ref[:] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q3, k3, v3, do3, lse, delta, causal, blk_q, blk_k, interpret):
+    """Pallas flash backward. q3/k3/v3/do3: [BH, S, D]; lse/delta: [BH, Sq]
+    fp32. Returns (dq, dk, dv) in [BH, S, D]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, Sq, D = q3.shape
+    Sk = k3.shape[1]
+    sm_scale = 1.0 / (D ** 0.5)
+    # Lane-pad the per-row statistics so their tiles are (blk, 128).
+    lse_p = jnp.broadcast_to(lse[:, :, None], (BH, Sq, 128))
+    delta_p = jnp.broadcast_to(delta[:, :, None], (BH, Sq, 128))
+
+    q_spec_qi = pl.BlockSpec((None, blk_q, D), lambda bh, qi, kb: (bh, qi, 0))
+    k_spec_kb = pl.BlockSpec((None, blk_k, D), lambda bh, qi, kb: (bh, kb, 0))
+    stat_spec_qi = pl.BlockSpec((None, blk_q, 128), lambda bh, qi, kb: (bh, qi, 0))
+    # dK/dV grid is (BH, kb, qi): swap the roles of the two inner dims.
+    q_spec_by_inner = pl.BlockSpec((None, blk_q, D), lambda bh, kb, qi: (bh, qi, 0))
+    k_spec_by_outer = pl.BlockSpec((None, blk_k, D), lambda bh, kb, qi: (bh, kb, 0))
+    stat_spec_by_inner = pl.BlockSpec((None, blk_q, 128),
+                                      lambda bh, kb, qi: (bh, qi, 0))
+
+    seq_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkdv_kernel, causal=causal,
+                          sm_scale=sm_scale),
+        grid=(BH, Sk // blk_k, Sq // blk_q),
+        in_specs=[q_spec_by_inner, k_spec_by_outer, k_spec_by_outer,
+                  q_spec_by_inner, stat_spec_by_inner, stat_spec_by_inner],
+        out_specs=[
+            pl.BlockSpec((None, blk_k, D), lambda bh, kb, qi: (bh, kb, 0)),
+            pl.BlockSpec((None, blk_k, D), lambda bh, kb, qi: (bh, kb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), k3.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, D), jnp.float32),
+            pltpu.VMEM((blk_k, D), jnp.float32),
+        ],
+        compiler_params=seq_params,
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse_p, delta_p)
+
+    (dq,) = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal,
+                          sm_scale=sm_scale),
+        grid=(BH, Sq // blk_q, Sk // blk_k),
+        in_specs=[q_spec_qi, k_spec_kb, k_spec_kb, q_spec_qi,
+                  stat_spec_qi, stat_spec_qi],
+        out_specs=[
+            pl.BlockSpec((None, blk_q, D), lambda bh, qi, kb: (bh, qi, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype)],
+        scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
+        compiler_params=seq_params,
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse_p, delta_p)
+    return dq, dk, dv
+
+
 def _flash_bwd_rule(causal, blk_q, blk_k, interpret, res, g):
-    """Flash-style backward: recompute probabilities blockwise from the saved
-    log-sum-exp; never materializes the full S x S matrix."""
+    """Flash backward as two Pallas kernels (dK/dV then dQ), recomputing
+    probabilities from the saved log-sum-exp — the S x S matrix never
+    materializes and VMEM holds one tile pair at a time."""
     q, k, v, out, lse = res
     B, Sq, H, D = q.shape
-    Sk = k.shape[1]
-    scale = 1.0 / (D ** 0.5)
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # [B,S,H]
-    lse4 = lse.reshape(B, H, Sq).transpose(0, 2, 1)  # [B,S,H]
-
-    n_blocks = max(1, Sq // blk_q)
-
-    def block_grads(qb_idx):
-        sl = lambda x: jax.lax.dynamic_slice_in_dim(x, qb_idx * blk_q, blk_q, 1)  # noqa: E731
-        qb, gb = sl(qf), sl(gf)
-        lseb, deltab = sl(lse4), sl(delta)
-        s = jnp.einsum("bqhd,bkhd->bhqk", qb, kf) * scale
-        if causal:
-            q_pos = qb_idx * blk_q + jnp.arange(blk_q)
-            cm = q_pos[:, None] >= jnp.arange(Sk)[None, :]
-            s = jnp.where(cm[None, None], s, NEG_INF)
-        p = jnp.exp(s - lseb.transpose(0, 2, 1)[:, :, :, None])
-        dv_b = jnp.einsum("bhqk,bqhd->bkhd", p, gb)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", gb, vf)
-        ds = p * (dp - deltab.transpose(0, 2, 1)[:, :, :, None]) * scale
-        dq_b = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
-        dk_b = jnp.einsum("bhqk,bqhd->bkhd", ds, qb)
-        return dq_b, dk_b, dv_b
-
-    dq_blocks, dk_blocks, dv_blocks = jax.lax.map(
-        block_grads, jnp.arange(n_blocks))
-    # dq_blocks: [n_blocks, B, blk_q, H, D] -> [B, Sq, H, D]
-    dq = dq_blocks.transpose(1, 0, 2, 3, 4).reshape(B, Sq, H, D)
-    dk = jnp.sum(dk_blocks, axis=0)
-    dv = jnp.sum(dv_blocks, axis=0)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # [B,Sq,H]
+    delta3 = delta.transpose(0, 2, 1).reshape(B * H, Sq)
+    dq3, dk3, dv3 = _flash_bwd(_to_bh3(q), _to_bh3(k), _to_bh3(v), _to_bh3(g),
+                               lse, delta3, causal, blk_q, blk_k, interpret)
+    return (_from_bh3(dq3, B, H).astype(q.dtype),
+            _from_bh3(dk3, B, H).astype(k.dtype),
+            _from_bh3(dv3, B, H).astype(v.dtype))
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

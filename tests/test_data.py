@@ -142,10 +142,18 @@ class TestPrefetch:
 
         # Producer finishes while both queue slots are full: the consumer
         # must still receive every item and terminate (no hang on the
-        # dropped sentinel).
+        # dropped sentinel). Drained in a thread with a deadline so a
+        # regression fails instead of hanging CI.
+        import threading
+
         it = prefetch_iterator(iter(range(5)), size=2)
         _time.sleep(0.5)  # let the producer fill the queue and finish
-        assert list(it) == [0, 1, 2, 3, 4]
+        got = []
+        t = threading.Thread(target=lambda: got.extend(it), daemon=True)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), "consumer hung waiting for the end sentinel"
+        assert got == [0, 1, 2, 3, 4]
 
     def test_prefetch_error_after_full_queue_reraises(self):
         import time as _time

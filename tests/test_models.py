@@ -24,15 +24,16 @@ class TestAttention:
         fl = flash_attention(q, k, v, True, 128, 128, True)  # interpret on CPU
         assert float(jnp.abs(ref - fl).max()) < 1e-4
 
-    def test_flash_gradients_match(self):
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_flash_gradients_match(self, causal):
         rng = np.random.default_rng(1)
         B, S, H, D = 1, 256, 2, 128
         q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
                    for _ in range(3))
         g_ref = jax.grad(lambda q, k, v: jnp.sum(
-            attention_reference(q, k, v, causal=True) ** 2), (0, 1, 2))(q, k, v)
+            attention_reference(q, k, v, causal=causal) ** 2), (0, 1, 2))(q, k, v)
         g_fl = jax.grad(lambda q, k, v: jnp.sum(
-            flash_attention(q, k, v, True, 128, 128, True) ** 2), (0, 1, 2))(q, k, v)
+            flash_attention(q, k, v, causal, 128, 128, True) ** 2), (0, 1, 2))(q, k, v)
         for a, b in zip(g_ref, g_fl):
             assert float(jnp.abs(a - b).max()) < 1e-3
 
