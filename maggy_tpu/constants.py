@@ -19,6 +19,8 @@ class USER_FCT:
 # Control-plane defaults (see BASELINE.md "scheduling constants").
 DEFAULT_HEARTBEAT_INTERVAL_S = 1.0
 DRIVER_IDLE_REQUEUE_TICK_S = 0.1
+# First GET retry after a miss; doubles up to DRIVER_IDLE_REQUEUE_TICK_S.
+CLIENT_GET_POLL_MIN_S = 0.005
 CLIENT_POLL_INTERVAL_S = 1.0
 REGISTRATION_TIMEOUT_S = 600.0
 RENDEZVOUS_TIMEOUT_S = 60.0

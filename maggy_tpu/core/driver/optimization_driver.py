@@ -322,6 +322,30 @@ class OptimizationDriver(Driver):
         """Re-poll the controller after a short tick (reference :419-439)."""
         self._assign_next(msg["partition_id"], msg.get("last_trial"))
 
+    def _rearm_idle(self, partition_id: int) -> None:
+        msg = {"type": "IDLE", "partition_id": partition_id, "last_trial": None}
+        timer = threading.Timer(constants.DRIVER_IDLE_REQUEUE_TICK_S,
+                                self.enqueue, args=(msg,))
+        timer.daemon = True
+        timer.start()
+
+    def _partition_state(self, partition_id: int) -> str:
+        """'live', 'silent' (heartbeats stopped past the loss bound), or
+        'released' (saw GSTOP — will never ask for work again). A
+        dead-while-idle runner otherwise keeps winning work through its
+        self-perpetuating IDLE timer chain — a requeued trial handed to it
+        costs a full extra LOST cycle."""
+        rec = self.server.reservations.get(partition_id)
+        if rec is None:
+            return "live"  # REG still in flight — not evidence of death
+        if rec.get("released"):
+            return "released"
+        bound = self.server.hb_loss_timeout
+        if bound is not None and \
+                time.monotonic() - rec.get("last_beat", 0) > bound:
+            return "silent"
+        return "live"
+
     def _assign_next(self, partition_id: int, last_trial: Optional[Trial]) -> None:
         # The controller, not a trial count, decides when the experiment is
         # over: multi-fidelity schedules (ASHA promotions, Hyperband brackets)
@@ -334,6 +358,21 @@ class OptimizationDriver(Driver):
         # reports) before any reassignment happens.
         suggestion = "IDLE" if last_trial is None \
             else self.controller.get_suggestion(last_trial)
+        state = self._partition_state(partition_id)
+        if state != "live":
+            # The controller has seen the FINAL; route any fresh suggestion
+            # to the requeue for a live runner instead of this one.
+            if suggestion not in (None, "IDLE"):
+                with self._store_lock:
+                    self._trial_store[suggestion.trial_id] = suggestion
+                    self._requeue.append(suggestion.trial_id)
+            # 'released' partitions saw GSTOP and never come back — drop
+            # their IDLE chain. A 'silent' one may be a transient stall
+            # (network hiccup): keep ticking so it resumes getting work if
+            # its heartbeats return, but without handing it trials now.
+            if state == "silent":
+                self._rearm_idle(partition_id)
+            return
         if suggestion in (None, "IDLE"):
             requeued = self._pop_requeue()
             if requeued is not None:
@@ -356,10 +395,7 @@ class OptimizationDriver(Driver):
             # Requeue after the idle tick from a timer, NOT by sleeping on the
             # single worker thread (64 idle runners would stall METRIC/FINAL
             # processing by ~0.6 s per cycle otherwise).
-            msg = {"type": "IDLE", "partition_id": partition_id, "last_trial": None}
-            timer = threading.Timer(0.1, self.enqueue, args=(msg,))
-            timer.daemon = True
-            timer.start()
+            self._rearm_idle(partition_id)
         elif suggestion is not None:
             with self._store_lock:
                 self._trial_store[suggestion.trial_id] = suggestion

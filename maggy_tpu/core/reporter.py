@@ -28,14 +28,54 @@ class Reporter:
         self._log_buffer: List[str] = []
         self._log_file = log_file
         self._print_tee = print_tee
+        self._metric_cache = None  # (device_array, float) identity pair
 
     # ------------------------------------------------------------- user API
 
+    @staticmethod
+    def _scalar_like(metric) -> bool:
+        """Accept plain numbers AND lazy single-element device arrays (jax
+        Array / 0-d numpy) WITHOUT forcing a device sync — shape/dtype are
+        metadata. Booleans are rejected either way."""
+        if isinstance(metric, bool):
+            return False
+        if isinstance(metric, (int, float, np.number)):
+            return True
+        shape = getattr(metric, "shape", None)
+        dtype = getattr(metric, "dtype", None)
+        if shape is None or dtype is None:
+            return False
+        try:
+            # Abstract tracers (broadcast called from INSIDE a jitted
+            # function) have shape/dtype but no value — rejecting them here
+            # keeps the user error in the user's thread instead of blowing
+            # up the heartbeat thread at materialization time.
+            from jax.core import Tracer
+
+            if isinstance(metric, Tracer):
+                return False
+        except Exception:  # noqa: BLE001 - no jax in this process
+            pass
+        try:
+            if not (np.issubdtype(dtype, np.floating) or np.issubdtype(dtype, np.integer)):
+                return False
+            return int(np.prod(shape)) == 1
+        except TypeError:
+            return False
+
     def broadcast(self, metric, step: Optional[int] = None) -> None:
         """Report an interim metric from the training loop. Raises
-        `EarlyStopException` if the driver has flagged this trial."""
+        `EarlyStopException` if the driver has flagged this trial.
+
+        ``metric`` may be a plain number OR a single-element device array
+        (e.g. the jax scalar a jitted train step returns). Device arrays are
+        kept LAZY: the training loop never blocks on a device->host sync —
+        the heartbeat thread materializes the newest value in `get_data()`.
+        Over a high-latency device link a blocking `float(loss)` per
+        reporting step would serialize the whole pipelined step stream
+        (measured ~50 ms/sync on a tunneled TPU chip)."""
         with self.lock:
-            if not isinstance(metric, (int, float, np.number)) or isinstance(metric, bool):
+            if not self._scalar_like(metric):
                 raise exceptions.BroadcastMetricTypeError(metric)
             if step is not None and (not isinstance(step, (int, np.integer)) or isinstance(step, bool)):
                 raise exceptions.BroadcastStepTypeError(step)
@@ -43,10 +83,16 @@ class Reporter:
                 step = self.step + 1 if self.step is not None else 0
             elif self.step is not None and step <= self.step:
                 raise exceptions.BroadcastStepValueError(step, self.step)
-            self.metric = float(metric)
+            self.metric = metric if isinstance(metric, float) else (
+                float(metric) if isinstance(metric, (int, np.number)) else metric)
             self.step = int(step)
             if self._stop_flag:
-                raise exceptions.EarlyStopException(self.metric)
+                raise exceptions.EarlyStopException(self._materialize(self.metric))
+
+    @staticmethod
+    def _materialize(metric):
+        """Device array -> float (blocks until the step producing it ran)."""
+        return metric if metric is None or isinstance(metric, float) else float(metric)
 
     def log(self, message: str, verbose: bool = True) -> None:
         with self.lock:
@@ -66,7 +112,20 @@ class Reporter:
         with self.lock:
             logs = self._log_buffer
             self._log_buffer = []
-            return {"metric": self.metric, "step": self.step, "logs": logs}
+            metric, step = self.metric, self.step
+        if metric is not None and not isinstance(metric, float):
+            # Materialize OUTSIDE the lock: the device sync (~50 ms over a
+            # tunneled chip) must not block the training thread's broadcast.
+            # Identity-cache so back-to-back heartbeats on the same value
+            # don't re-fetch.
+            cached = self._metric_cache
+            if cached is not None and cached[0] is metric:
+                metric = cached[1]
+            else:
+                value = self._materialize(metric)
+                self._metric_cache = (metric, value)
+                metric = value
+        return {"metric": metric, "step": step, "logs": logs}
 
     def early_stop(self) -> None:
         """Arm the stop flag (only once a metric exists, reference
@@ -82,3 +141,4 @@ class Reporter:
             self._stop_flag = False
             self._log_buffer = []
             self.trial_id = trial_id
+            self._metric_cache = None

@@ -194,8 +194,11 @@ class Server:
         # here when runners are external agents; None rejects JOINs.
         self.join_info: Optional[Dict[str, Any]] = None
         self._join_lock = threading.Lock()
-        self._next_join_pid = 0
-        self._issued_pids: set = set()
+        # pid -> monotonic issue time. A slot is "taken" while its JOIN is
+        # fresher than the liveness bound or its holder has registered; an
+        # issued-but-never-registered slot expires and becomes reclaimable
+        # (the joining agent died before REG).
+        self._issued_pids: Dict[int, float] = {}
         # Heartbeat-liveness bound used by JOIN slot-reclaim checks (and, in
         # OptimizationServer, the loss scan). None disables.
         self.hb_loss_timeout: Optional[float] = None
@@ -225,34 +228,49 @@ class Server:
             return {"type": "ERR",
                     "error": "this experiment does not accept remote runners"}
         want = msg.get("partition_id")
+        liveness = self.hb_loss_timeout or 10.0
+        now = time.monotonic()
         with self._join_lock:
             if want is not None and int(want) >= 0:
                 # Explicit pid: a restarted agent resuming its slot (its REG
                 # will take the re-registration BLACK path). Refuse slots
-                # outside the experiment and slots whose holder is still
-                # alive — two agents sharing a pid would interleave GET/
-                # FINAL and corrupt trial bookkeeping.
+                # outside the experiment, slots whose holder is still alive,
+                # AND slots issued to a not-yet-registered joiner — two
+                # agents sharing a pid would interleave GET/FINAL and corrupt
+                # trial bookkeeping (the adjacent-JOIN race: both JOIN before
+                # either REGs).
                 pid = int(want)
                 if pid >= self.num_executors:
                     return {"type": "ERR",
                             "error": "partition_id {} out of range (experiment "
                                      "has {} slots)".format(pid, self.num_executors)}
                 rec = self.reservations.get(pid)
-                liveness = self.hb_loss_timeout or 10.0
-                if rec is not None and not rec.get("released") and \
-                        time.monotonic() - rec.get("last_beat", 0) < liveness:
+                released = rec is not None and rec.get("released")
+                if not released and rec is not None and \
+                        now - rec.get("last_beat", 0) < liveness:
                     return {"type": "ERR",
                             "error": "slot {} is held by a live runner".format(pid)}
-                self._issued_pids.add(pid)
+                # A fresh issue means another agent just took this slot (it
+                # may not have REG'd yet) — checked on every path, stale or
+                # released record included, or two replacements racing for
+                # the same dead/released slot would both be admitted.
+                issued = self._issued_pids.get(pid)
+                if issued is not None and now - issued < liveness:
+                    return {"type": "ERR",
+                            "error": "slot {} was just issued to another "
+                                     "joining runner".format(pid)}
+                self._issued_pids[pid] = now
             else:
-                taken = set(self.reservations.all()) | self._issued_pids
-                while self._next_join_pid in taken:
-                    self._next_join_pid += 1
-                if self._next_join_pid >= self.num_executors:
+                registered = self.reservations.all()
+                taken = set(registered) | {
+                    p for p, t in self._issued_pids.items()
+                    if now - t < liveness
+                }
+                pid = next((i for i in range(self.num_executors)
+                            if i not in taken), None)
+                if pid is None:
                     return {"type": "ERR", "error": "experiment full"}
-                pid = self._next_join_pid
-                self._issued_pids.add(pid)
-                self._next_join_pid += 1
+                self._issued_pids[pid] = now
         return {"type": "JOIN", "partition_id": pid, **info}
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
@@ -657,6 +675,14 @@ class Client:
                         reporter.early_stop()
                 except ConnectionError:
                     pass
+                except Exception as e:  # noqa: BLE001
+                    # Metric materialization / serialization failures must
+                    # not kill this thread: a dead heartbeat thread reads as
+                    # runner death -> false LOST -> duplicate trial run.
+                    try:
+                        reporter.log("heartbeat error: {!r}".format(e))
+                    except Exception:  # noqa: BLE001
+                        pass
                 self._hb_stop.wait(self.hb_interval)
 
         self._hb_thread = threading.Thread(target=beat, daemon=True, name="heartbeat")
@@ -664,8 +690,15 @@ class Client:
 
     def get_suggestion(self, timeout: Optional[float] = None):
         """Blocking poll for the next trial; returns (trial_id, params) or
-        (None, None) when the experiment is over (reference `rpc.py:537-546`)."""
+        (None, None) when the experiment is over (reference `rpc.py:537-546`).
+
+        Adaptive poll: the common miss is the race between this GET and the
+        driver worker processing the FINAL we just sent (sub-ms), so the
+        first retries come fast (5 ms doubling) and only a genuinely idle
+        wait (rung barrier) backs off to the 0.1 s driver tick — per-trial
+        hand-off latency stays in single-digit ms instead of a flat 0.1 s."""
         deadline = time.monotonic() + timeout if timeout else None
+        delay = constants.CLIENT_GET_POLL_MIN_S
         while True:
             resp = self._request({"type": "GET"})
             rtype = resp.get("type")
@@ -679,7 +712,8 @@ class Client:
                 return resp["trial_id"], resp["params"]
             if deadline and time.monotonic() > deadline:
                 return None, None
-            time.sleep(constants.DRIVER_IDLE_REQUEUE_TICK_S)
+            time.sleep(delay)
+            delay = min(delay * 2, constants.DRIVER_IDLE_REQUEUE_TICK_S)
 
     def get_dist_config(self, timeout: float = constants.RENDEZVOUS_TIMEOUT_S):
         deadline = time.monotonic() + timeout

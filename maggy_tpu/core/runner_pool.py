@@ -198,8 +198,24 @@ class RemoteRunnerPool(RunnerPool):
                         constants.REGISTRATION_TIMEOUT_S,
                         drv.exp_dir + "/runner_ticket.json"))
             time.sleep(0.2)
+        # Experiment wait, with an all-agents-dead liveness bound: if every
+        # admitted agent has gone silent past the heartbeat-loss timeout,
+        # nobody is left to poll GET — requeued trials would never be picked
+        # up and this loop would spin forever. Surfacing the failure lets the
+        # driver abort with the real cause instead of hanging.
         while not drv.experiment_done:
             time.sleep(0.2)
+            bound = drv.server.hb_loss_timeout
+            if bound is None:
+                continue
+            registered = drv.server.reservations.all()
+            active = {pid for pid, rec in registered.items()
+                      if not rec.get("released")}
+            if active and active <= set(drv.server.reservations.silent(bound)):
+                return [RuntimeError(
+                    "all {} remote agent(s) silent for > {:.0f}s with the "
+                    "experiment incomplete; presumed dead (partitions {})".format(
+                        len(active), bound, sorted(active)))]
         # Don't let the driver tear the server down under agents that have
         # not yet observed GSTOP — their next poll would hit a dead socket
         # and crash an otherwise-successful agent. Dead agents can't ack, so

@@ -14,28 +14,117 @@ from maggy_tpu.train import ShardedBatchIterator, Trainer, cross_entropy_loss
 from maggy_tpu.train.trainer import next_token_loss
 
 
+def _qkv(rng, B, Sq, H, D, Sk=None, Hkv=None):
+    Sk = Sq if Sk is None else Sk
+    Hkv = H if Hkv is None else Hkv
+    q = jnp.asarray(rng.normal(size=(B, Sq, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, Sk, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, Sk, Hkv, D)), jnp.float32)
+    return q, k, v
+
+
 class TestAttention:
     def test_flash_matches_reference(self):
-        rng = np.random.default_rng(0)
-        B, S, H, D = 2, 256, 2, 128
-        q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
-                   for _ in range(3))
+        q, k, v = _qkv(np.random.default_rng(0), 2, 256, 2, 128)
         ref = attention_reference(q, k, v, causal=True)
-        fl = flash_attention(q, k, v, True, 128, 128, True)  # interpret on CPU
+        fl = flash_attention(q, k, v, None, True, 128, 128, True)  # interpret
         assert float(jnp.abs(ref - fl).max()) < 1e-4
 
     @pytest.mark.parametrize("causal", [True, False])
     def test_flash_gradients_match(self, causal):
-        rng = np.random.default_rng(1)
-        B, S, H, D = 1, 256, 2, 128
-        q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
-                   for _ in range(3))
+        q, k, v = _qkv(np.random.default_rng(1), 1, 256, 2, 128)
         g_ref = jax.grad(lambda q, k, v: jnp.sum(
             attention_reference(q, k, v, causal=causal) ** 2), (0, 1, 2))(q, k, v)
         g_fl = jax.grad(lambda q, k, v: jnp.sum(
-            flash_attention(q, k, v, causal, 128, 128, True) ** 2), (0, 1, 2))(q, k, v)
+            flash_attention(q, k, v, None, causal, 128, 128, True) ** 2),
+            (0, 1, 2))(q, k, v)
         for a, b in zip(g_ref, g_fl):
             assert float(jnp.abs(a - b).max()) < 1e-3
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_flash_gqa_no_repeat(self, causal):
+        """Hkv < H: kv tiles are shared via index maps, never repeated.
+        Values AND gradients (dk/dv sum over the head group) must match."""
+        q, k, v = _qkv(np.random.default_rng(2), 2, 256, 4, 128, Hkv=2)
+        ref = attention_reference(q, k, v, causal=causal)
+        fl = flash_attention(q, k, v, None, causal, 128, 128, True)
+        assert float(jnp.abs(ref - fl).max()) < 1e-4
+        g_ref = jax.grad(lambda q, k, v: jnp.sum(
+            attention_reference(q, k, v, causal=causal) ** 2), (0, 1, 2))(q, k, v)
+        g_fl = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, None, causal, 128, 128, True) ** 2),
+            (0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_fl):
+            assert a.shape == b.shape
+            assert float(jnp.abs(a - b).max()) < 1e-3
+
+    def test_flash_key_padding_mask(self):
+        """BERT-config masking: [B, Sk] keep-mask, last 64 keys padded."""
+        B, S, H, D = 2, 256, 2, 64
+        q, k, v = _qkv(np.random.default_rng(3), B, S, H, D)
+        keep = jnp.asarray(
+            np.arange(S)[None, :] < np.array([S - 64, S - 13])[:, None])
+        ref = attention_reference(q, k, v, causal=False,
+                                  mask=keep[:, None, None, :])
+        fl = flash_attention(q, k, v, keep, False, 128, 128, True)
+        assert float(jnp.abs(ref - fl).max()) < 1e-4
+        g_ref = jax.grad(lambda q, k, v: jnp.sum(attention_reference(
+            q, k, v, causal=False, mask=keep[:, None, None, :]) ** 2),
+            (0, 1, 2))(q, k, v)
+        g_fl = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, keep, False, 128, 128, True) ** 2),
+            (0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_fl):
+            assert float(jnp.abs(a - b).max()) < 1e-3
+
+    def test_flash_cross_lengths_causal(self):
+        """Sq != Sk with bottom-right-aligned causal masking (decode window
+        over a longer key cache)."""
+        q, k, v = _qkv(np.random.default_rng(4), 1, 128, 2, 128, Sk=384)
+        ref = attention_reference(q, k, v, causal=True)
+        fl = flash_attention(q, k, v, None, True, 128, 128, True)
+        assert float(jnp.abs(ref - fl).max()) < 1e-4
+        g_ref = jax.grad(lambda q, k, v: jnp.sum(
+            attention_reference(q, k, v, causal=True) ** 2), (0, 1, 2))(q, k, v)
+        g_fl = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, None, True, 128, 128, True) ** 2),
+            (0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_fl):
+            assert float(jnp.abs(a - b).max()) < 1e-3
+
+    def test_flash_head_dim_64(self):
+        """BERT-base head_dim (64): tiles lane-pad, values still match."""
+        q, k, v = _qkv(np.random.default_rng(5), 2, 128, 4, 64)
+        ref = attention_reference(q, k, v, causal=True)
+        fl = flash_attention(q, k, v, None, True, 128, 128, True)
+        assert float(jnp.abs(ref - fl).max()) < 1e-4
+
+    def test_dispatch_accepts_bert_shapes(self):
+        """force='flash' must accept the BERT baseline config's call:
+        head_dim 64, padding mask [B,1,1,Sk], causal=False."""
+        from maggy_tpu.ops.attention import multi_head_attention
+
+        B, S, H, D = 2, 128, 4, 64
+        q, k, v = _qkv(np.random.default_rng(6), B, S, H, D)
+        keep = jnp.asarray(np.arange(S)[None, :] < np.array([100, 77])[:, None])
+        out = multi_head_attention(q, k, v, causal=False,
+                                   mask=keep[:, None, None, :], force="flash")
+        ref = attention_reference(q, k, v, causal=False,
+                                  mask=keep[:, None, None, :])
+        assert float(jnp.abs(out - ref).max()) < 1e-4
+
+    def test_dispatch_falls_back_on_query_structured_mask(self):
+        from maggy_tpu.ops.attention import multi_head_attention
+
+        B, S, H, D = 1, 128, 2, 64
+        q, k, v = _qkv(np.random.default_rng(7), B, S, H, D)
+        mask = jnp.tril(jnp.ones((S, S), bool))[None, None]  # per-query
+        with pytest.raises(ValueError, match="force='flash'"):
+            multi_head_attention(q, k, v, causal=False, mask=mask,
+                                 force="flash")
+        out = multi_head_attention(q, k, v, causal=False, mask=mask)
+        ref = attention_reference(q, k, v, causal=False, mask=mask)
+        assert float(jnp.abs(out - ref).max()) < 1e-5
 
 
 class TestModelsForward:

@@ -48,7 +48,15 @@ class TestJoinProtocol:
             # Experiment full -> rejected.
             with pytest.raises(RuntimeError, match="full"):
                 join_experiment(addr, server.secret_hex)
-            # Explicit slot reclaim always admitted (restart recovery).
+            # Explicit reclaim of a slot whose JOIN was just issued (holder
+            # not yet registered) is REFUSED — admitting it would put two
+            # live agents on one pid, interleaving their GET/FINAL streams.
+            server.hb_loss_timeout = 0.3
+            with pytest.raises(RuntimeError, match="issued"):
+                join_experiment(addr, server.secret_hex, partition_id=1)
+            # Once the issue is stale with no registration (joiner died
+            # before REG), reclaim is admitted (restart recovery).
+            time.sleep(0.4)
             r = join_experiment(addr, server.secret_hex, partition_id=1)
             assert r["partition_id"] == 1
         finally:
@@ -176,6 +184,60 @@ class TestRemoteDistributedE2E:
         # metric = process_index per worker -> average 0.5 proves both
         # ranks reported through the control plane.
         assert result["average_metric"] == 0.5
+
+
+class TestAllAgentsDead:
+    def test_driver_fails_instead_of_hanging(self, local_env, tmp_path):
+        """Every remote agent dying silently must FAIL the experiment, not
+        hang the driver forever: heartbeat loss requeues the dead agents'
+        trials, but with no live runner left to poll GET the schedule can
+        never complete — RemoteRunnerPool.run's liveness bound surfaces it."""
+        config = OptimizationConfig(
+            name="dead_agents", num_trials=4, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2])),
+            direction="max", num_workers=1, hb_interval=0.1,
+            hb_loss_timeout=1.0, seed=7, es_policy="none", pool="remote",
+            bind_host="127.0.0.1",
+        )
+        box = {}
+
+        def drive():
+            try:
+                box["result"] = experiment.lagom(
+                    load_train_fn("remote_train_module:train_fn"), config)
+            except BaseException as e:  # noqa: BLE001
+                box["exc"] = e
+
+        driver_thread = threading.Thread(target=drive, daemon=True)
+        driver_thread.start()
+
+        ticket_path = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and ticket_path is None:
+            hits = glob.glob(str(tmp_path / "exp" / "*" / "runner_ticket.json"))
+            if hits:
+                ticket_path = hits[0]
+            time.sleep(0.1)
+        assert ticket_path, "driver never published runner_ticket.json"
+        ticket = json.loads(open(ticket_path).read())
+
+        # One agent joins, registers, grabs a trial — then vanishes: no
+        # heartbeats, no FINAL, no GSTOP ack.
+        from maggy_tpu.core.rpc import Client
+        from maggy_tpu.runner import join_experiment as join
+
+        addr = (ticket["host"], ticket["port"])
+        info = join(addr, ticket["secret"])
+        client = Client(addr, info["partition_id"], 0, 0.1, ticket["secret"])
+        client.register()
+        client.get_suggestion(timeout=5)
+        client.stop()
+
+        driver_thread.join(timeout=30)
+        assert not driver_thread.is_alive(), \
+            "driver hung after all agents died"
+        assert "exc" in box, "driver completed despite an unrunnable schedule"
+        assert "silent" in str(box["exc"]) or "did not complete" in str(box["exc"])
 
 
 class TestMonitor:

@@ -264,3 +264,160 @@ class TestHeartbeatLoss:
         client.stop()
         time.sleep(1.0)
         assert not any(m["type"] == "LOST" for m in driver.messages)
+
+
+class TestJoinAdmission:
+    """JOIN double-admission race (explicit-pid path): two agents JOINing
+    the same pid before the first REGs must not both be admitted."""
+
+    def _server(self):
+        server = OptimizationServer(num_executors=2)
+        server.attach_driver(FakeDriver())
+        server.join_info = {"hb_interval": 0.1, "exp_dir": "/tmp/x",
+                            "optimization_key": "metric",
+                            "trial_type": "optimization"}
+        server.hb_loss_timeout = 0.4
+        return server
+
+    def test_explicit_pid_rejected_while_issue_fresh(self):
+        from maggy_tpu.runner import join_experiment
+
+        server = self._server()
+        addr = server.start()
+        try:
+            first = join_experiment(addr, server.secret_hex)
+            # Holder has NOT registered yet — a second explicit JOIN for the
+            # same pid must be refused, not admitted alongside it.
+            with pytest.raises(RuntimeError, match="issued"):
+                join_experiment(addr, server.secret_hex,
+                                partition_id=first["partition_id"])
+            # Stale issue with no REG (joiner died pre-registration):
+            # reclaim admitted.
+            time.sleep(0.5)
+            r = join_experiment(addr, server.secret_hex,
+                                partition_id=first["partition_id"])
+            assert r["partition_id"] == first["partition_id"]
+        finally:
+            server.stop()
+
+    def test_explicit_pid_rejected_while_holder_alive(self):
+        from maggy_tpu.runner import join_experiment
+
+        server = self._server()
+        addr = server.start()
+        try:
+            info = join_experiment(addr, server.secret_hex)
+            pid = info["partition_id"]
+            client = make_client(addr, server, pid=pid)
+            client.register()
+            with pytest.raises(RuntimeError, match="live runner"):
+                join_experiment(addr, server.secret_hex, partition_id=pid)
+            # Holder goes silent past the liveness bound -> restart recovery.
+            client.stop()
+            time.sleep(0.5)
+            r = join_experiment(addr, server.secret_hex, partition_id=pid)
+            assert r["partition_id"] == pid
+        finally:
+            server.stop()
+
+    def test_racing_replacements_for_dead_slot(self):
+        """Stale reservation record + two replacement agents racing for the
+        slot: only the FIRST reclaim wins; the second is refused until the
+        first's issue goes stale (double-admission via the stale-rec path)."""
+        from maggy_tpu.runner import join_experiment
+
+        server = self._server()
+        addr = server.start()
+        try:
+            info = join_experiment(addr, server.secret_hex)
+            pid = info["partition_id"]
+            client = make_client(addr, server, pid=pid)
+            client.register()
+            client.stop()
+            time.sleep(0.5)  # holder now silent past the liveness bound
+            r = join_experiment(addr, server.secret_hex, partition_id=pid)
+            assert r["partition_id"] == pid
+            with pytest.raises(RuntimeError, match="issued"):
+                join_experiment(addr, server.secret_hex, partition_id=pid)
+        finally:
+            server.stop()
+
+    def test_fresh_join_reclaims_expired_issue(self):
+        from maggy_tpu.runner import join_experiment
+
+        server = self._server()
+        addr = server.start()
+        try:
+            a = join_experiment(addr, server.secret_hex)
+            b = join_experiment(addr, server.secret_hex)
+            assert {a["partition_id"], b["partition_id"]} == {0, 1}
+            with pytest.raises(RuntimeError, match="full"):
+                join_experiment(addr, server.secret_hex)
+            # Neither joiner ever registers; their issues expire and the
+            # slots become available to fresh joins again.
+            time.sleep(0.5)
+            r = join_experiment(addr, server.secret_hex)
+            assert r["partition_id"] in (0, 1)
+        finally:
+            server.stop()
+
+
+class TestAssignNextDeadPartition:
+    """A released or heartbeat-silent partition must not win assignments or
+    keep its IDLE timer chain alive (its self-perpetuating timers otherwise
+    race live runners for requeued trials, costing a full LOST cycle)."""
+
+    @pytest.fixture
+    def driver(self, tmp_path):
+        from maggy_tpu.config import OptimizationConfig
+        from maggy_tpu.core.driver.optimization_driver import OptimizationDriver
+        from maggy_tpu.core.environment import EnvSing
+        from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+        from maggy_tpu.searchspace import Searchspace
+
+        EnvSing.set_instance(LocalEnv(base_dir=str(tmp_path / "exp")))
+        config = OptimizationConfig(
+            name="assign_dead", num_trials=4, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 1.0])),
+            direction="max", num_workers=2, seed=2, es_policy="none",
+        )
+        drv = OptimizationDriver(config, "app", 0)
+        yield drv
+        drv.stop()
+        EnvSing.reset()
+
+    def test_released_partition_gets_no_idle_rearm(self, driver):
+        driver.server.reservations.add({"partition_id": 0})
+        driver.server.reservations.mark_released(0)
+        driver._assign_next(0, None)
+        assert driver.server.reservations.get_assigned_trial(0) is None
+        assert not driver._trial_store
+        # No IDLE timer was armed for the dead partition.
+        time.sleep(0.25)
+        assert driver._message_q.empty()
+
+    def test_requeued_trial_skips_dead_partition(self, driver):
+        trial = Trial({"lr": 0.5})
+        driver._trial_store[trial.trial_id] = trial
+        driver._requeue.append(trial.trial_id)
+        driver.server.reservations.add({"partition_id": 0})
+        driver.server.reservations.mark_released(0)
+        driver.server.reservations.add({"partition_id": 1})
+        driver._assign_next(0, None)
+        assert driver.server.reservations.get_assigned_trial(0) is None
+        assert trial.trial_id in driver._requeue
+        driver._assign_next(1, None)
+        assert driver.server.reservations.get_assigned_trial(1) == trial.trial_id
+
+    def test_final_from_dead_partition_requeues_fresh_suggestion(self, driver):
+        """The controller must still see the FINAL (rung/pruner bookkeeping),
+        but the follow-up suggestion goes to the requeue, not the corpse."""
+        done = Trial({"lr": 0.1})
+        done.status = Trial.FINALIZED
+        done.final_metric = 1.0
+        driver.server.reservations.add({"partition_id": 0})
+        driver.server.reservations.mark_released(0)
+        driver._assign_next(0, done)
+        assert driver.server.reservations.get_assigned_trial(0) is None
+        assert len(driver._requeue) == 1
+        assert driver._requeue[0] in driver._trial_store
