@@ -71,6 +71,9 @@ def train_fn(lora_rank, lora_alpha, lr, budget=1, reporter=None):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=9)
+    ap.add_argument("--resource-max", type=float, default=9,
+                    help="ASHA top-rung budget (1 = single rung, e.g. for "
+                         "smoke runs with few trials)")
     args = ap.parse_args()
 
     sp = Searchspace(
@@ -80,8 +83,8 @@ def main():
     )
     config = OptimizationConfig(
         name="llama_lora_sweep", num_trials=args.trials,
-        optimizer=Asha(reduction_factor=3, resource_min=1, resource_max=9,
-                       seed=0),
+        optimizer=Asha(reduction_factor=3, resource_min=1,
+                       resource_max=args.resource_max, seed=0),
         searchspace=sp, direction="max", num_workers=3, es_policy="none",
         seed=0,
     )

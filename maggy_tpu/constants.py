@@ -34,6 +34,9 @@ RPC_RECV_BUFSIZE = 1 << 16
 HEARTBEAT_LOSS_FACTOR = 30.0
 HEARTBEAT_LOSS_MIN_S = 10.0
 
+# Multi-fidelity bracket-state checkpoint (resume=True with Hyperband).
+PRUNER_STATE_FILE = ".pruner_state.json"
+
 # Early-stop defaults (reference `maggy/experiment_config.py:33-35`).
 DEFAULT_ES_INTERVAL = 1
 DEFAULT_ES_MIN = 10

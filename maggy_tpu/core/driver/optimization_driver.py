@@ -176,11 +176,6 @@ class OptimizationDriver(Driver):
     def _validate_resume(self) -> None:
         from maggy_tpu.optimizers.bayes.base import BaseAsyncBO
 
-        if self.controller.pruner is not None:
-            raise ValueError(
-                "resume=True is not supported with a pruner (Hyperband) "
-                "schedule; its bracket state is not checkpointed."
-            )
         if isinstance(self.controller, (RandomSearch, BaseAsyncBO)) \
                 and self.controller.seed is None:
             raise ValueError(
@@ -210,6 +205,19 @@ class OptimizationDriver(Driver):
         # result.json covers all the trials it claims to.
         self.result["early_stopped"] += sum(1 for t in restored if t.early_stop)
         self.controller.restore(restored)
+        if self.controller.pruner is not None:
+            path = self.exp_dir + "/" + constants.PRUNER_STATE_FILE
+            if not self.env.exists(path):
+                if restored:
+                    raise ValueError(
+                        "resume=True with a pruner needs the bracket-state "
+                        "checkpoint {}; this experiment predates pruner "
+                        "checkpointing.".format(path))
+            else:
+                self.controller.pruner.load_state_dict(
+                    json.loads(self.env.load(path)))
+                self.controller.pruner.restore(
+                    {t.trial_id for t in restored})
         self._log("resume: restored {} finalized trials from {}".format(
             len(restored), self.exp_dir))
 
@@ -310,6 +318,7 @@ class OptimizationDriver(Driver):
             report = getattr(self.controller.pruner, "report_failure", None)
             if report:
                 report(trial.trial_id)
+                self._checkpoint_pruner()
         self._update_result(trial)
         self.env.dump(trial.to_json(),
                       "{}/{}/trial.json".format(self.exp_dir, trial.trial_id))
@@ -321,6 +330,19 @@ class OptimizationDriver(Driver):
     def _idle_msg_callback(self, msg) -> None:
         """Re-poll the controller after a short tick (reference :419-439)."""
         self._assign_next(msg["partition_id"], msg.get("last_trial"))
+
+    def _checkpoint_pruner(self) -> None:
+        """Persist multi-fidelity bracket state (a few KB of JSON) so an
+        interrupted Hyperband schedule resumes without re-running finalized
+        rungs. Runs on the driver worker thread only."""
+        pruner = self.controller.pruner
+        if pruner is None or not hasattr(pruner, "state_dict"):
+            return
+        try:
+            self.env.dump(json.dumps(pruner.state_dict()),
+                          self.exp_dir + "/" + constants.PRUNER_STATE_FILE)
+        except Exception:  # noqa: BLE001 - checkpointing must not kill a run
+            pass
 
     def _rearm_idle(self, partition_id: int) -> None:
         msg = {"type": "IDLE", "partition_id": partition_id, "last_trial": None}
@@ -342,7 +364,7 @@ class OptimizationDriver(Driver):
             return "released"
         bound = self.server.hb_loss_timeout
         if bound is not None and \
-                time.monotonic() - rec.get("last_beat", 0) > bound:
+                self.server.reservations.is_silent(partition_id, bound):
             return "silent"
         return "live"
 
@@ -399,6 +421,10 @@ class OptimizationDriver(Driver):
         elif suggestion is not None:
             with self._store_lock:
                 self._trial_store[suggestion.trial_id] = suggestion
+            # The controller just mutated its schedule (Hyperband bound the
+            # new run to a bracket slot) — persist so resume=True can pick
+            # the bracket up mid-flight.
+            self._checkpoint_pruner()
             suggestion.set_status(Trial.SCHEDULED)
             self.server.reservations.assign_trial(partition_id, suggestion.trial_id)
 

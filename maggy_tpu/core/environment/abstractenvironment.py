@@ -157,29 +157,36 @@ class LocalEnv(AbstractEnv):
 
 class GCSEnv(LocalEnv):
     """GCS-backed environment for multi-host TPU pods: same interface over a
-    ``gs://`` base dir via fsspec/gcsfs when available. Falls back to local
-    paths otherwise (gated: gcsfs is not bundled in every image)."""
+    ``gs://`` base dir via an fsspec filesystem (gcsfs by default).
 
-    def __init__(self, base_dir: str):
+    ``fs`` is injectable — tests drive the full contract against fsspec's
+    in-memory filesystem; production omits it and gets gcsfs.
+    """
+
+    def __init__(self, base_dir: str, fs=None):
         if not base_dir.startswith("gs://"):
             raise ValueError("GCSEnv requires a gs:// base dir")
-        try:
-            import gcsfs  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "GCSEnv requires gcsfs; install it or use LocalEnv with an "
-                "NFS-shared base dir."
-            ) from e
+        if fs is None:
+            try:
+                import gcsfs
+            except ImportError as e:
+                raise ImportError(
+                    "GCSEnv requires gcsfs; install it or use LocalEnv with "
+                    "an NFS-shared base dir."
+                ) from e
+            fs = gcsfs.GCSFileSystem()
         super().__init__(base_dir)
-        import gcsfs
-
-        self.fs = gcsfs.GCSFileSystem()
+        self.fs = fs
 
     def exists(self, path: str) -> bool:
         return self.fs.exists(path)
 
     def mkdir(self, path: str) -> None:
-        pass  # GCS has no directories
+        # Real, not a no-op: GCS itself has no directories, but fsspec
+        # emulates them (placeholder entries) so isdir()/ls() on a freshly
+        # registered experiment dir behave like LocalEnv before the first
+        # object lands in it.
+        self.fs.makedirs(path, exist_ok=True)
 
     def dump(self, data: str, path: str) -> None:
         with self.fs.open(path, "w") as f:
@@ -187,7 +194,7 @@ class GCSEnv(LocalEnv):
 
     def load(self, path: str) -> str:
         with self.fs.open(path, "r") as f:
-            return f.read()
+            return AbstractEnv.str_or_byte(f.read())
 
     def open_file(self, path: str, mode: str = "r"):
         return self.fs.open(path, mode)
@@ -196,11 +203,19 @@ class GCSEnv(LocalEnv):
         return self.fs.isdir(path)
 
     def ls(self, path: str) -> List[str]:
-        # gcsfs returns full object paths; the AbstractEnv contract (and
-        # util.build_summary) expects bare entry names like LocalEnv.
+        # fsspec returns full object paths; the AbstractEnv contract (and
+        # util.build_summary) expects bare entry names like LocalEnv, and
+        # [] for a missing path.
         import os as _os
 
-        return sorted(_os.path.basename(p.rstrip("/")) for p in self.fs.ls(path))
+        if not self.fs.isdir(path):
+            return []
+        return sorted(
+            _os.path.basename(AbstractEnv.str_or_byte(
+                p["name"] if isinstance(p, dict) else p).rstrip("/"))
+            for p in self.fs.ls(path)
+        )
 
     def delete(self, path: str, recursive: bool = False) -> None:
-        self.fs.rm(path, recursive=recursive)
+        if self.fs.exists(path):
+            self.fs.rm(path, recursive=recursive)

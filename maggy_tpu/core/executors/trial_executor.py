@@ -141,9 +141,9 @@ class TrialExecutor:
                         ctx.close()
         finally:
             try:
-                # Flush the last trial's TensorBoard events (torch's writer
-                # only auto-flushes every 120 s — short final trials would
-                # lose their events otherwise).
+                # Close the last trial's TensorBoard session: writes its
+                # hparams session_end record and flushes the event file
+                # (short final trials would lose buffered events otherwise).
                 from maggy_tpu import tensorboard as tb
 
                 tb._close()

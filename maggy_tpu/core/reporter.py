@@ -83,8 +83,8 @@ class Reporter:
                 step = self.step + 1 if self.step is not None else 0
             elif self.step is not None and step <= self.step:
                 raise exceptions.BroadcastStepValueError(step, self.step)
-            self.metric = metric if isinstance(metric, float) else (
-                float(metric) if isinstance(metric, (int, np.number)) else metric)
+            self.metric = float(metric) \
+                if isinstance(metric, (int, np.number)) else metric
             self.step = int(step)
             if self._stop_flag:
                 raise exceptions.EarlyStopException(self._materialize(self.metric))
@@ -110,14 +110,14 @@ class Reporter:
 
     def get_data(self) -> Dict[str, Any]:
         with self.lock:
-            logs = self._log_buffer
-            self._log_buffer = []
             metric, step = self.metric, self.step
         if metric is not None and not isinstance(metric, float):
             # Materialize OUTSIDE the lock: the device sync (~50 ms over a
             # tunneled chip) must not block the training thread's broadcast.
             # Identity-cache so back-to-back heartbeats on the same value
-            # don't re-fetch.
+            # don't re-fetch. Runs BEFORE the log drain below — if the
+            # device value is poisoned and float() raises, the buffered
+            # logs stay queued for the next beat instead of vanishing.
             cached = self._metric_cache
             if cached is not None and cached[0] is metric:
                 metric = cached[1]
@@ -125,6 +125,9 @@ class Reporter:
                 value = self._materialize(metric)
                 self._metric_cache = (metric, value)
                 metric = value
+        with self.lock:
+            logs = self._log_buffer
+            self._log_buffer = []
         return {"metric": metric, "step": step, "logs": logs}
 
     def early_stop(self) -> None:

@@ -126,6 +126,17 @@ class Reservations:
         with self.lock:
             return self._silent_locked(timeout)
 
+    def is_silent(self, partition_id, timeout: float) -> bool:
+        """Single-partition form of `silent`: registered, unreleased, and
+        beat-less for longer than ``timeout``. The ONE home of the
+        last_beat liveness predicate — JOIN admission and the driver's
+        dead-partition checks both consult it."""
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            if rec is None or rec.get("released"):
+                return False
+            return time.monotonic() - rec.get("last_beat", 0) > timeout
+
     def lost_assignments(self, timeout: float):
         """Silent partitions that hold a trial: [(partition_id, trial_id)].
         Read-only; the caller decides recovery."""
@@ -245,9 +256,8 @@ class Server:
                             "error": "partition_id {} out of range (experiment "
                                      "has {} slots)".format(pid, self.num_executors)}
                 rec = self.reservations.get(pid)
-                released = rec is not None and rec.get("released")
-                if not released and rec is not None and \
-                        now - rec.get("last_beat", 0) < liveness:
+                if rec is not None and not rec.get("released") and \
+                        not self.reservations.is_silent(pid, liveness):
                     return {"type": "ERR",
                             "error": "slot {} is held by a live runner".format(pid)}
                 # A fresh issue means another agent just took this slot (it
@@ -665,6 +675,17 @@ class Client:
             while not self._hb_stop.is_set():
                 try:
                     data = reporter.get_data()
+                except Exception as e:  # noqa: BLE001
+                    # Metric materialization failures (poisoned device
+                    # value) must neither kill this thread NOR silence the
+                    # beat: a missed beat reads as runner death -> false
+                    # LOST -> duplicate trial run. Beat with no metric.
+                    try:
+                        reporter.log("heartbeat error: {!r}".format(e))
+                    except Exception:  # noqa: BLE001
+                        pass
+                    data = {"metric": None, "step": None, "logs": []}
+                try:
                     resp = self._request(
                         {"type": "METRIC", "trial_id": reporter.trial_id,
                          "value": data["metric"], "step": data["step"],
@@ -675,14 +696,6 @@ class Client:
                         reporter.early_stop()
                 except ConnectionError:
                     pass
-                except Exception as e:  # noqa: BLE001
-                    # Metric materialization / serialization failures must
-                    # not kill this thread: a dead heartbeat thread reads as
-                    # runner death -> false LOST -> duplicate trial run.
-                    try:
-                        reporter.log("heartbeat error: {!r}".format(e))
-                    except Exception:  # noqa: BLE001
-                        pass
                 self._hb_stop.wait(self.hb_interval)
 
         self._hb_thread = threading.Thread(target=beat, daemon=True, name="heartbeat")

@@ -179,10 +179,8 @@ class Attention(nn.Module):
                     "got an explicit mask")
             from maggy_tpu.parallel.ring_attention import ring_attention
 
-            if cfg.num_kv_heads != cfg.num_heads:
-                rep = cfg.num_heads // cfg.num_kv_heads
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
+            # GQA rides the ring natively: k/v rotate with Hkv heads and
+            # the flash path indexes the shared kv head per group.
             out = ring_attention(q, k, v, cfg.seq_mesh,
                                  axis_name=cfg.seq_axis, causal=True)
         else:

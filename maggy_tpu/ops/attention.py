@@ -508,6 +508,42 @@ def _flash_bwd_rule(causal, blk_q, blk_k, interpret, res, g):
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+# ------------------------------------------------- ring-attention building blocks
+
+
+def flash_block_fwd(q, k, v, causal: bool = True, blk_q: int = 128,
+                    blk_k: int = 128, interpret: bool = False):
+    """One (Q shard, K/V shard) flash forward returning BOTH the normalized
+    block output and its log-sum-exp — the partial-softmax state ring
+    attention merges across shards. q: [B,Sq,H,D], k/v: [B,Sk,Hkv,D];
+    returns (out [B,Sq,H,D], lse [B,H,Sq] fp32). Not differentiable on its
+    own: the ring owns the VJP (see parallel/ring_attention.py)."""
+    out, lse = _flash_fwd_4d(q, k, v, None, causal, blk_q, blk_k, interpret)
+    B, Sq, H, _ = q.shape
+    return out, lse.reshape(B, H, Sq)
+
+
+def flash_block_bwd(q, k, v, do, lse, delta, causal: bool = True,
+                    blk_q: int = 128, blk_k: int = 128,
+                    interpret: bool = False):
+    """One block of the ring-attention backward: given the GLOBAL per-row
+    log-sum-exp and delta = sum(dO*O), each (Q shard, K/V shard) pair's
+    gradient contribution is independent and additive — p recomputed from
+    the global lse is the true global probability for this block.
+    lse/delta: [B,H,Sq] fp32. Returns (dq, dk, dv) fp32."""
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    R = H // Hkv
+    stats = lambda x: x.reshape(B, Hkv, R, 1, Sq).astype(jnp.float32)  # noqa: E731
+    dqg, dkg, dvg = _flash_bwd(
+        _grouped_q(q, Hkv), _grouped_kv(k), _grouped_kv(v),
+        _grouped_q(do, Hkv), stats(lse), stats(delta), None,
+        causal, blk_q, blk_k, interpret)
+    return (_ungroup_q(dqg).astype(jnp.float32),
+            _ungroup_kv(dkg).astype(jnp.float32),
+            _ungroup_kv(dvg).astype(jnp.float32))
+
+
 # ----------------------------------------------------------------- dispatch
 
 

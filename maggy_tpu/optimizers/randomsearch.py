@@ -56,8 +56,17 @@ class RandomSearch(AbstractOptimizer):
             return None
         parent_id, budget = next_run["trial_id"], next_run["budget"]
         if parent_id is None:
-            # fresh rung-0 config
+            # fresh rung-0 config, with duplicate detection (reference
+            # `abstractoptimizer.py:254-295`): after resume=True the seeded
+            # rng REPLAYS the interrupted run's sample sequence — without
+            # this the bracket would re-evaluate configs that already
+            # finalized instead of exploring fresh ones.
             params = self.searchspace.get_random_parameter_values(1, rng=self.rng)[0]
+            for _ in range(32):
+                if not self.hparams_exist(Trial(dict(params))):
+                    break
+                params = self.searchspace.get_random_parameter_values(
+                    1, rng=self.rng)[0]
             new_trial = self.create_trial(params, sample_type="random", run_budget=budget)
         else:
             # promoted config re-run at a bigger budget

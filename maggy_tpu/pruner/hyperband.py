@@ -197,3 +197,50 @@ class Hyperband(AbstractPruner):
             return False
         metrics = self.trial_metric_getter()
         return all(it.check_finished(metrics) for it in self.iterations)
+
+    # ------------------------------------------------------ checkpoint/resume
+
+    def state_dict(self) -> dict:
+        """Bracket state as plain JSON-able data (SURVEY.md §5.4: the driver
+        checkpoints this per scheduling transition so `resume=True` works
+        with a pruner). The pending hand-out is deliberately NOT saved — at
+        restore time an un-finalized hand-out is simply re-issued."""
+        return {
+            "iterations": [
+                {
+                    "iteration_id": it.iteration_id,
+                    "n_configs": it.n_configs,
+                    "budgets": it.budgets,
+                    "state": it.state,
+                    "configs": {str(r): list(slots)
+                                for r, slots in it.configs.items()},
+                }
+                for it in self.iterations
+            ]
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.iterations = []
+        for rec in state.get("iterations", []):
+            it = SHIteration(rec["iteration_id"], list(rec["n_configs"]),
+                             list(rec["budgets"]))
+            it.state = rec["state"]
+            it.configs = {int(r): list(slots)
+                          for r, slots in rec["configs"].items()}
+            self.iterations.append(it)
+
+    def restore(self, finalized_ids) -> None:
+        """Reconcile restored bracket state with the trials that actually
+        finalized: slots bound to runs the interrupted experiment never
+        finished are dropped (their rungs re-issue them), and each bracket's
+        state is recomputed from the surviving metrics."""
+        finalized_ids = set(finalized_ids)
+        metrics = self.trial_metric_getter()
+        for it in self.iterations:
+            it._pending = None
+            for rung in list(it.configs):
+                it.configs[rung] = [s for s in it.configs[rung]
+                                    if s["actual"] in finalized_ids]
+            it.state = (SHIteration.INIT if not any(it.configs.values())
+                        else SHIteration.RUNNING)
+            it.check_finished(metrics)

@@ -1,29 +1,98 @@
-"""Per-trial TensorBoard integration.
+"""Per-trial TensorBoard integration — torch-free.
 
 Parity: reference `maggy/tensorboard.py` — module-global logdir registered
 per trial (:25-44), HParams-plugin experiment config for the searchspace
-(:75-87) and per-trial hparams (:90-93). Implemented over
-`torch.utils.tensorboard` (bundled; avoids importing full TF) with a JSON
-fallback, plus `jax.profiler` trace capture as the idiomatic TPU addition
-(SURVEY.md §5.1).
+(:75-87) and per-trial hparams (:90-93). The reference writes real TF event
+files through `tf.summary`; a JAX framework must not pull in torch (or a
+full TF session) for that, so this module writes event files directly with
+the `tensorboard` package's own `EventFileWriter` + HParams-plugin protos:
+
+- `add_scalar` -> a `Summary.Value(simple_value=...)` event per call;
+- `write_hparams` -> the HParams plugin's `session_start_info` record (the
+  dashboard groups each trial dir as one session);
+- `_close` -> `session_end_info` (STATUS_SUCCESS) + flush;
+- `write_experiment_config` -> the experiment-level `hparams_config` record
+  mapping the Searchspace to HParam domains (dashboard column setup).
+
+Falls back to JSON artifacts when the `tensorboard` package is absent.
+`jax.profiler` trace capture is the idiomatic TPU addition (SURVEY.md §5.1);
+traces land in the trial logdir and open in TB's profile plugin.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Dict, Optional
 
 _logdir: Optional[str] = None
 _writer = None
 
 
+def _clean_hparams(hparams: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v if isinstance(v, (int, float, str, bool)) else str(v)
+            for k, v in hparams.items()}
+
+
+class _EventWriter:
+    """Thin wrapper over tensorboard's EventFileWriter with the HParams
+    plugin records. Proto note: when tensorflow is installed the hparams
+    helpers return TF-flavored protos while EventFileWriter wants
+    tensorboard.compat protos — they are wire-identical, so we re-parse."""
+
+    def __init__(self, logdir: str):
+        from tensorboard.summary.writer.event_file_writer import EventFileWriter
+
+        self._writer = EventFileWriter(logdir)
+
+    def _event(self, **kwargs):
+        from tensorboard.compat.proto.event_pb2 import Event
+
+        return Event(wall_time=time.time(), **kwargs)
+
+    def _compat(self, summary):
+        from tensorboard.compat.proto.summary_pb2 import Summary
+
+        if isinstance(summary, Summary):
+            return summary
+        return Summary.FromString(summary.SerializeToString())
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        from tensorboard.compat.proto.summary_pb2 import Summary
+
+        summary = Summary(value=[Summary.Value(tag=tag,
+                                               simple_value=float(value))])
+        self._writer.add_event(self._event(step=int(step), summary=summary))
+
+    def write_hparams(self, hparams: Dict[str, Any],
+                      metrics: Optional[Dict[str, float]]) -> None:
+        from tensorboard.plugins.hparams import summary as hp_summary
+
+        start = hp_summary.session_start_pb(_clean_hparams(hparams))
+        self._writer.add_event(self._event(summary=self._compat(start)))
+        for tag, value in (metrics or {}).items():
+            self.add_scalar(tag, value, 0)
+
+    def write_experiment(self, summary_pb) -> None:
+        self._writer.add_event(self._event(summary=self._compat(summary_pb)))
+
+    def close(self) -> None:
+        from tensorboard.plugins.hparams import summary as hp_summary
+
+        try:
+            end = hp_summary.session_end_pb("STATUS_SUCCESS")
+            self._writer.add_event(self._event(summary=self._compat(end)))
+        except Exception:  # noqa: BLE001 - close must always flush
+            pass
+        self._writer.flush()
+        self._writer.close()
+
+
 def _make_writer(logdir: str):
     try:
-        from torch.utils.tensorboard import SummaryWriter
-
-        return SummaryWriter(log_dir=logdir)
-    except Exception:  # noqa: BLE001 - TB optional; JSON fallback below
+        return _EventWriter(logdir)
+    except Exception:  # noqa: BLE001 - tensorboard optional; JSON fallback
         return None
 
 
@@ -67,12 +136,46 @@ def write_hparams(hparams: Dict[str, Any], metrics: Optional[Dict[str, float]] =
     if _logdir is None:
         return
     if _writer is not None:
-        clean = {k: v if isinstance(v, (int, float, str, bool)) else str(v)
-                 for k, v in hparams.items()}
-        _writer.add_hparams(clean, metrics or {}, run_name=".")
+        _writer.write_hparams(hparams, metrics)
     else:
         with open(os.path.join(_logdir, "hparams.json"), "w") as f:
             json.dump(hparams, f, default=str)
+
+
+def _experiment_pb(searchspace):
+    """Searchspace -> HParams-plugin experiment config proto (the dashboard
+    column setup; reference `tensorboard.py:75-87`)."""
+    from tensorboard.plugins.hparams import api as hp
+    from tensorboard.plugins.hparams import summary_v2 as hp_v2
+
+    hparams = []
+    for name, spec in searchspace.to_dict().items():
+        hp_type, region = spec["type"], spec["values"]
+        if hp_type == "DOUBLE":
+            dom = hp.RealInterval(float(region[0]), float(region[1]))
+        elif hp_type == "INTEGER":
+            dom = hp.IntInterval(int(region[0]), int(region[1]))
+        else:  # DISCRETE / CATEGORICAL
+            dom = hp.Discrete(list(region))
+        hparams.append(hp.HParam(name, dom))
+    return hp_v2.hparams_config_pb(
+        hparams=hparams, metrics=[hp.Metric("metric")])
+
+
+def write_experiment_config(exp_dir: str, searchspace) -> None:
+    """Experiment-level HParams dashboard config, written once at startup
+    into ``exp_dir/tensorboard`` (TB treats each trial dir as a session
+    under this root)."""
+    if searchspace is None:
+        return
+    try:
+        pb = _experiment_pb(searchspace)
+        w = _EventWriter(os.path.join(exp_dir, "tensorboard"))
+        w.write_experiment(pb)
+        w._writer.flush()
+        w._writer.close()
+    except Exception:  # noqa: BLE001 - TB must never block an experiment
+        pass
 
 
 def start_trace(trace_dir: Optional[str] = None) -> None:

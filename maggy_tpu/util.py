@@ -70,6 +70,11 @@ def write_hparams_config(exp_dir: str, searchspace, env=None) -> None:
         return
     env = env or EnvSing.get_instance()
     env.dump(json.dumps(searchspace.to_dict(), indent=2), exp_dir + "/searchspace.json")
+    # HParams dashboard column config (real TB event file, torch-free;
+    # best-effort — write_experiment_config swallows its own failures).
+    from maggy_tpu import tensorboard as tb
+
+    tb.write_experiment_config(exp_dir, searchspace)
 
 
 def build_summary(exp_dir: str, env=None) -> Dict[str, Any]:

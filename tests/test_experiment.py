@@ -56,9 +56,12 @@ class TestRandomSearchE2E:
         assert json.loads(local_env.load(exp_dir + "/result.json"))["num_trials"] == 8
         meta = json.loads(local_env.load(exp_dir + "/experiment.json"))
         assert meta["state"] == "FINISHED"
+        # A trial dir is one holding trial.json (exp_dir also carries the
+        # experiment-level tensorboard/ hparams-config dir).
         trial_dirs = [d for d in os.listdir(exp_dir)
-                      if os.path.isdir(os.path.join(exp_dir, d))]
+                      if os.path.exists(os.path.join(exp_dir, d, "trial.json"))]
         assert len(trial_dirs) == 8
+        assert os.path.isdir(os.path.join(exp_dir, "tensorboard"))
         for td in trial_dirs:
             full = os.path.join(exp_dir, td)
             assert os.path.exists(full + "/.hparams.json")

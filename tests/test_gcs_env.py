@@ -1,0 +1,97 @@
+"""GCSEnv contract tests against fsspec's in-memory filesystem.
+
+The production filesystem (gcsfs) needs credentials + network; the contract
+— dump/load/ls/delete/mkdir/registry/build_summary — is filesystem-agnostic
+through fsspec, so an injected MemoryFileSystem exercises every code path.
+"""
+
+import json
+
+import pytest
+from fsspec.implementations.memory import MemoryFileSystem
+
+from maggy_tpu import util
+from maggy_tpu.core.environment.abstractenvironment import GCSEnv
+
+BASE = "gs://bucket/maggy-exp"
+
+
+@pytest.fixture
+def env():
+    fs = MemoryFileSystem()
+    # MemoryFileSystem is process-global storage; isolate each test.
+    fs.store.clear()
+    return GCSEnv(BASE, fs=fs)
+
+
+class TestContract:
+    def test_requires_gs_scheme(self):
+        with pytest.raises(ValueError, match="gs://"):
+            GCSEnv("/local/path", fs=MemoryFileSystem())
+
+    def test_mkdir_is_real(self, env):
+        path = BASE + "/exp_0"
+        assert not env.isdir(path)
+        env.mkdir(path)
+        assert env.isdir(path)
+        assert env.ls(path) == []
+
+    def test_dump_load_exists(self, env):
+        path = BASE + "/exp_0/trial.json"
+        assert not env.exists(path)
+        env.dump('{"a": 1}', path)
+        assert env.exists(path)
+        assert json.loads(env.load(path)) == {"a": 1}
+
+    def test_ls_bare_names(self, env):
+        env.dump("x", BASE + "/exp_0/t1/trial.json")
+        env.dump("x", BASE + "/exp_0/t2/trial.json")
+        env.dump("y", BASE + "/exp_0/result.json")
+        names = env.ls(BASE + "/exp_0")
+        assert names == ["result.json", "t1", "t2"]
+
+    def test_ls_missing_is_empty(self, env):
+        assert env.ls(BASE + "/nope") == []
+
+    def test_delete(self, env):
+        env.dump("x", BASE + "/exp_0/a.json")
+        env.delete(BASE + "/exp_0/a.json")
+        assert not env.exists(BASE + "/exp_0/a.json")
+        env.delete(BASE + "/exp_0/a.json")  # idempotent like LocalEnv
+        env.dump("x", BASE + "/exp_1/t/f.json")
+        env.delete(BASE + "/exp_1", recursive=True)
+        assert not env.exists(BASE + "/exp_1/t/f.json")
+
+    def test_open_file_roundtrip(self, env):
+        with env.open_file(BASE + "/exp_0/log.txt", "w") as f:
+            f.write("line\n")
+        with env.open_file(BASE + "/exp_0/log.txt") as f:
+            assert GCSEnv.str_or_byte(f.read()) == "line\n"
+
+
+class TestRegistry:
+    def test_register_update_finalize(self, env):
+        exp_dir = env.register_experiment("app", 3, {"name": "n"})
+        assert exp_dir == BASE + "/app_3"
+        meta = json.loads(env.load(exp_dir + "/experiment.json"))
+        assert meta["state"] == "RUNNING" and meta["name"] == "n"
+        env.update_experiment(exp_dir, {"extra": 1})
+        env.finalize_experiment(exp_dir, "FINISHED", {"result": {"best": 2}})
+        meta = json.loads(env.load(exp_dir + "/experiment.json"))
+        assert meta["state"] == "FINISHED"
+        assert meta["extra"] == 1 and meta["result"]["best"] == 2
+
+
+class TestBuildSummary:
+    def test_summary_over_trial_dirs(self, env):
+        exp_dir = env.register_experiment("app", 0, {})
+        for tid, metric in [("t1", 0.5), ("t2", 0.9)]:
+            env.dump(json.dumps({"lr": 0.1}),
+                     "{}/{}/.hparams.json".format(exp_dir, tid))
+            env.dump(json.dumps({"metric": metric}),
+                     "{}/{}/.outputs.json".format(exp_dir, tid))
+        summary = util.build_summary(exp_dir, env=env)
+        assert len(summary["combinations"]) == 2
+        ids = {c["id"] for c in summary["combinations"]}
+        assert ids == {"t1", "t2"}
+        assert env.exists(exp_dir + "/.summary.json")

@@ -77,7 +77,9 @@ class TestResumeE2E:
                              cfg(num_trials=2, resume=True,
                                  experiment_dir=str(tmp_path / "fresh")))
 
-    def test_resume_with_pruner_rejected(self, tmp_path, monkeypatch):
+    def test_resume_with_pruner_needs_state_checkpoint(self, tmp_path, monkeypatch):
+        """A pruner resume against an experiment dir with finalized trials
+        but NO bracket-state checkpoint must refuse (legacy run)."""
         count_dir = tmp_path / "counts2"
         count_dir.mkdir()
         monkeypatch.setenv("MAGGY_TEST_COUNT_DIR", str(count_dir))
@@ -86,11 +88,11 @@ class TestResumeE2E:
                          cfg(num_trials=2, experiment_dir=exp_base))
         from maggy_tpu.optimizers import RandomSearch
 
-        with pytest.raises(ValueError, match="pruner"):
+        with pytest.raises(ValueError, match="checkpoint"):
             experiment.lagom(
                 train_counting,
                 cfg(num_trials=27, resume=True, experiment_dir=exp_base,
-                    optimizer=RandomSearch(pruner="hyperband",
+                    optimizer=RandomSearch(seed=5, pruner="hyperband",
                                            pruner_kwargs={"max_budget": 9})))
 
 
@@ -148,6 +150,117 @@ class TestInterruptedRunResume:
                         experiment_dir=str(exp_base), resume=True))
         finally:
             exp_mod.APP_ID = old
+
+
+HYPERBAND_SCRIPT = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from maggy_tpu import OptimizationConfig, Searchspace, experiment
+from maggy_tpu.optimizers import RandomSearch
+
+def train(lr, units, budget=1, reporter=None):
+    marker = os.path.join(os.environ["MAGGY_TEST_COUNT_DIR"],
+                          "{{:.10f}}_{{}}_{{}}".format(lr, units, budget))
+    with open(marker, "a") as f:
+        f.write("x")
+    time.sleep(float(os.environ.get("MAGGY_TEST_TRIAL_SLEEP", "0")))
+    return {{"metric": 1.0 - (lr - 0.1) ** 2 + 0.01 * budget}}
+
+config = OptimizationConfig(
+    name="hb_resume",
+    optimizer=RandomSearch(
+        seed=7, pruner="hyperband",
+        pruner_kwargs={{"min_budget": 1, "max_budget": 4, "eta": 2,
+                        "n_iterations": 2}}),
+    searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2]),
+                            units=("INTEGER", [8, 64])),
+    direction="max", num_workers=2, hb_interval=0.05, seed=7,
+    es_policy="none", experiment_dir=os.environ["MAGGY_TEST_EXP_DIR"],
+    resume=os.environ.get("MAGGY_TEST_RESUME") == "1",
+)
+result = experiment.lagom(train, config)
+print("NUM_TRIALS", result["num_trials"])
+"""
+
+
+class TestHyperbandResume:
+    # Hyperband(min=1, max=4, eta=2, n_iterations=2):
+    # bracket 0 = [4, 2, 1] runs, bracket 1 = [3, 1] runs -> 11 total.
+    TOTAL_RUNS = 11
+    WORKERS = 2
+
+    def test_kill_and_resume_mid_bracket(self, tmp_path, monkeypatch):
+        """Kill a Hyperband sweep mid-bracket (SIGKILL, no cleanup); resume
+        must complete the 11-run schedule without re-running finalized
+        slots — only runs in flight at kill time may execute twice (their
+        slot is dropped at restore and re-issued)."""
+        import glob as _glob
+        import signal
+        import subprocess
+        import sys as _sys
+        import time as _time
+
+        count_dir = tmp_path / "counts"
+        count_dir.mkdir()
+        exp_base = tmp_path / "exp"
+        script = tmp_path / "hb_run.py"
+        script.write_text(HYPERBAND_SCRIPT.format(
+            repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+        env = dict(os.environ)
+        env.update(MAGGY_TEST_COUNT_DIR=str(count_dir),
+                   MAGGY_TEST_EXP_DIR=str(exp_base),
+                   MAGGY_TEST_TRIAL_SLEEP="0.4",
+                   MAGGY_TPU_APP_ID="hbapp", JAX_PLATFORMS="cpu")
+
+        proc = subprocess.Popen([_sys.executable, str(script)], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+        try:
+            deadline = _time.monotonic() + 90
+            while _time.monotonic() < deadline:
+                done = _glob.glob(str(exp_base / "hbapp_0" / "*" / "trial.json"))
+                if len(done) >= 4:
+                    break
+                if proc.poll() is not None:
+                    out = proc.stdout.read().decode()
+                    pytest.fail("sweep finished before the kill:\n" + out)
+                _time.sleep(0.1)
+            else:
+                pytest.fail("never reached 4 finalized trials")
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        pre_finalized = len(
+            _glob.glob(str(exp_base / "hbapp_0" / "*" / "trial.json")))
+        assert pre_finalized >= 4
+        assert (exp_base / "hbapp_0" / ".pruner_state.json").exists()
+
+        # Resume in-process: fast trials, same seed/app id.
+        monkeypatch.setenv("MAGGY_TEST_COUNT_DIR", str(count_dir))
+        monkeypatch.setenv("MAGGY_TEST_TRIAL_SLEEP", "0")
+        monkeypatch.setenv("MAGGY_TEST_EXP_DIR", str(exp_base))
+        monkeypatch.setenv("MAGGY_TEST_RESUME", "1")
+        monkeypatch.setenv("MAGGY_TPU_APP_ID", "hbapp")
+        res = subprocess.run([_sys.executable, str(script)],
+                             env={**env, "MAGGY_TEST_TRIAL_SLEEP": "0",
+                                  "MAGGY_TEST_RESUME": "1"},
+                             capture_output=True, text=True, timeout=300)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "NUM_TRIALS {}".format(self.TOTAL_RUNS) in res.stdout
+
+        markers = os.listdir(count_dir)
+        sizes = [os.path.getsize(count_dir / m) for m in markers]
+        # Every scheduled slot ran; only in-flight-at-kill runs may repeat
+        # (re-executed marker or a replacement sample), bounded by workers.
+        assert len(markers) >= self.TOTAL_RUNS
+        assert sum(sizes) <= self.TOTAL_RUNS + 2 * self.WORKERS
+        rerun_excess = sum(s - 1 for s in sizes)
+        assert rerun_excess <= self.WORKERS, \
+            "finalized runs were re-executed: {}".format(markers)
 
 
 class TestAshaRestore:
