@@ -29,7 +29,11 @@ def make_data(n=2048, key=0):
 
 DATA_X, DATA_Y = make_data()
 STEPS_PER_BUDGET = 25
-BATCH = 256
+# Swept batch sizes: trial DURATION varies ~4x across the space — the
+# normal shape of a real sweep (batch/width/depth hparams change cost), and
+# precisely what stage-based execution pays for: every synchronized wave
+# waits for its slowest member, while the async scheduler backfills.
+BATCH_CHOICES = [128, 256, 512]
 
 
 def _bench_loss(logits, batch):
@@ -38,9 +42,10 @@ def _bench_loss(logits, batch):
     return cross_entropy_loss(logits, batch["labels"])
 
 
-def train_mnist(lr, budget=1, reporter=None):
-    """One ASHA trial: budget-scaled training of the MNIST CNN. Shapes are
-    hparam-independent so XLA's compile cache amortizes across trials."""
+def train_mnist(lr, batch=256, budget=1, reporter=None):
+    """One ASHA trial: budget-scaled training of the MNIST CNN. Shapes
+    depend only on the DISCRETE batch hparam, so the whole sweep compiles
+    exactly len(BATCH_CHOICES) train steps (shared via step_key)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -53,15 +58,15 @@ def train_mnist(lr, budget=1, reporter=None):
     mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
     model = MnistCNN(kernel_size=3, pool_size=2, features=16, num_classes=2)
     # lr rides in opt_state (swept_transform) and the step is shared via
-    # step_key: the whole sweep compiles its train step ONCE.
+    # step_key: one compile per batch size for the whole sweep.
     trainer = Trainer(
         model, swept_transform(optax.adam, learning_rate=lr),
         _bench_loss, mesh, strategy="dp", step_key=("bench_mnist", "adam"),
     )
     trainer.init(jax.random.key(0), (jnp.zeros((1, 16, 16, 1)),))
     steps = int(STEPS_PER_BUDGET * budget)
-    it = iter(ShardedBatchIterator({"x": DATA_X, "y": DATA_Y}, batch_size=BATCH,
-                                   epochs=None, seed=1))
+    it = iter(ShardedBatchIterator({"x": DATA_X, "y": DATA_Y},
+                                   batch_size=int(batch), epochs=None, seed=1))
     loss = None
     for i in range(steps):
         b = next(it)
@@ -81,7 +86,8 @@ def run_framework_sweep(num_trials=18, workers=3):
     from maggy_tpu import OptimizationConfig, Searchspace, experiment
     from maggy_tpu.optimizers import Asha
 
-    sp = Searchspace(lr=("DOUBLE", [1e-4, 3e-2]))
+    sp = Searchspace(lr=("DOUBLE", [1e-4, 3e-2]),
+                     batch=("DISCRETE", BATCH_CHOICES))
     # ASHA multi-fidelity schedule + median-rule mid-trial early stopping:
     # the two async control loops the reference pitches against stage-based
     # execution (`README.rst:21-26`). The wave baseline below runs the SAME
@@ -99,27 +105,27 @@ def run_framework_sweep(num_trials=18, workers=3):
 
 
 def run_wave_baseline(schedule, workers=3):
-    """The same (lr, budget) runs executed in SYNCHRONIZED WAVES of
+    """The same (lr, batch, budget) runs executed in SYNCHRONIZED WAVES of
     ``workers`` — stage-based execution, the Spark-native alternative the
     reference positions itself against (`README.rst:21-26`): every wave
     waits for its slowest trial before the next batch starts, so mixed ASHA
-    budgets (1x/3x/9x) leave workers idle on stragglers. Device parallelism
-    is identical to the framework run; only the scheduling differs."""
+    budgets (1x/3x/9x) and batch sizes (1x-4x step cost) leave workers idle
+    on stragglers. Device parallelism is identical to the framework run;
+    only the scheduling differs."""
     import threading
 
     errors = []
 
-    def run(lr, budget):
+    def run(lr, batch, budget):
         try:
-            train_mnist(lr, budget=budget)
+            train_mnist(lr, batch=batch, budget=budget)
         except BaseException as e:  # noqa: BLE001
             errors.append(e)
 
     t0 = time.time()
     for i in range(0, len(schedule), workers):
         wave = schedule[i:i + workers]
-        threads = [threading.Thread(target=run, args=(lr, budget))
-                   for lr, budget in wave]
+        threads = [threading.Thread(target=run, args=args) for args in wave]
         for t in threads:
             t.start()
         for t in threads:
@@ -132,6 +138,195 @@ def run_wave_baseline(schedule, workers=3):
 
 def log(msg):
     print("[bench] {}".format(msg), file=sys.stderr, flush=True)
+
+
+# ------------------------------------------------------------- MFU + kernels
+
+# Peak bf16 matmul throughput per chip, by device_kind prefix.
+CHIP_PEAK_FLOPS = [
+    ("TPU v5 lite", 197e12),  # v5e
+    ("TPU v5e", 197e12),
+    ("TPU v5p", 459e12),
+    ("TPU v6", 918e12),
+    ("TPU v4", 275e12),
+    ("TPU v3", 123e12),
+]
+
+
+def chip_peak_flops():
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for prefix, peak in CHIP_PEAK_FLOPS:
+        if kind.startswith(prefix):
+            return kind, peak
+    return kind, 197e12  # conservative default; kind is recorded alongside
+
+
+def _time_fn(fn, *args, iters=10, warmup=2):
+    """Median wall time of ``fn(*args)`` with device sync per call."""
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def bench_llama_mfu():
+    """Jitted train step of a one-chip Llama config (bf16, flash attention)
+    -> step time + model FLOPs utilization. FLOPs counted as the standard
+    6 * params * tokens plus the attention term 12 * L * H * D * S^2
+    (fwd+bwd, causal halves the scores but the bwd recompute restores it)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from maggy_tpu.models import Llama, LlamaConfig
+    from maggy_tpu.parallel import make_mesh
+    from maggy_tpu.train import Trainer, next_token_loss
+
+    B = int(os.environ.get("BENCH_LLAMA_BATCH", "4"))
+    S = int(os.environ.get("BENCH_LLAMA_SEQ", "2048"))
+    cfg = LlamaConfig(
+        vocab_size=32000,
+        hidden_dim=int(os.environ.get("BENCH_LLAMA_HIDDEN", "2048")),
+        intermediate_dim=int(os.environ.get("BENCH_LLAMA_INTER", "5632")),
+        num_layers=int(os.environ.get("BENCH_LLAMA_LAYERS", "8")),
+        num_heads=16, num_kv_heads=8, head_dim=128, max_seq_len=S,
+        dtype=jnp.bfloat16,
+    )
+    mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+    model = Llama(cfg)
+    trainer = Trainer(
+        model, optax.adamw(3e-4),
+        lambda logits, batch: next_token_loss(logits, batch["tokens"]),
+        mesh, strategy="dp")
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(B, S)), jnp.int32)
+    trainer.init(jax.random.key(0), (tokens,))
+    n_params = sum(int(np.prod(x.shape)) for x in
+                   jax.tree_util.tree_leaves(trainer.variables))
+    batch = trainer.place_batch({"inputs": (tokens,), "tokens": tokens})
+
+    def step(b):
+        return trainer.step(b)
+
+    sec = _time_fn(step, batch, iters=8)
+    tokens_per_step = B * S
+    attn_flops = 12 * cfg.num_layers * cfg.num_heads * cfg.head_dim * S * S * B
+    flops = 6.0 * n_params * tokens_per_step + attn_flops
+    kind, peak = chip_peak_flops()
+    return {
+        "model": "llama {}L/{}h (bf16, flash)".format(
+            cfg.num_layers, cfg.hidden_dim),
+        "params_m": round(n_params / 1e6, 1),
+        "step_time_ms": round(sec * 1e3, 2),
+        "tokens_per_s": round(tokens_per_step / sec),
+        "mfu": round(flops / sec / peak, 4),
+        "chip": kind,
+    }
+
+
+def bench_bert_mfu():
+    """BERT-base fwd+bwd step time (head_dim 64 + padding mask: the shapes
+    that now dispatch to the Pallas kernel)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from maggy_tpu.models import BertConfig, BertEncoder
+    from maggy_tpu.parallel import make_mesh
+    from maggy_tpu.train import Trainer, cross_entropy_loss
+
+    B = int(os.environ.get("BENCH_BERT_BATCH", "32"))
+    S = int(os.environ.get("BENCH_BERT_SEQ", "128"))
+    cfg = BertConfig.base(num_classes=2)
+    mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+    model = BertEncoder(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(B, S)), jnp.int32)
+    attn_mask = jnp.asarray(
+        np.arange(S)[None, :] < rng.integers(S // 2, S + 1, size=(B, 1)))
+    labels = jnp.asarray(rng.integers(0, 2, size=(B,)), jnp.int32)
+    trainer = Trainer(
+        model, optax.adamw(3e-5),
+        lambda logits, batch: cross_entropy_loss(logits, batch["labels"]),
+        mesh, strategy="dp")
+    trainer.init(jax.random.key(0), (tokens,),
+                 init_kwargs={"attention_mask": attn_mask})
+    n_params = sum(int(np.prod(x.shape)) for x in
+                   jax.tree_util.tree_leaves(trainer.variables))
+    batch = trainer.place_batch(
+        {"inputs": (tokens, attn_mask), "labels": labels})
+    sec = _time_fn(lambda b: trainer.step(b), batch, iters=8)
+    kind, peak = chip_peak_flops()
+    flops = 6.0 * n_params * B * S
+    return {
+        "model": "bert-base S={} (padding-mask flash)".format(S),
+        "params_m": round(n_params / 1e6, 1),
+        "step_time_ms": round(sec * 1e3, 2),
+        "examples_per_s": round(B / sec, 1),
+        "mfu": round(flops / sec / peak, 4),
+        "chip": kind,
+    }
+
+
+def bench_flash_vs_xla():
+    """flash_attention vs attention_reference, fwd+bwd, at S = 2k/4k/8k.
+    The dispatch default is Pallas on TPU; this records the measured edge."""
+    import jax
+    import jax.numpy as jnp
+
+    from maggy_tpu.ops.attention import attention_reference, flash_attention
+
+    out = {}
+    for S, B in ((2048, 4), (4096, 2), (8192, 1)):
+        H, D = 8, 128
+        rng = np.random.default_rng(S)
+        q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
+                   for _ in range(3))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, None, True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+        g_flash = jax.jit(jax.grad(loss_flash, (0, 1, 2)))
+        g_ref = jax.jit(jax.grad(loss_ref, (0, 1, 2)))
+        t_flash = _time_fn(g_flash, q, k, v, iters=6)
+        t_ref = _time_fn(g_ref, q, k, v, iters=6)
+        out["S{}".format(S)] = {
+            "flash_ms": round(t_flash * 1e3, 2),
+            "xla_ms": round(t_ref * 1e3, 2),
+            "speedup": round(t_ref / t_flash, 2),
+        }
+    return out
+
+
+def run_extra_benches():
+    """MFU + kernel measurements; each is best-effort so a failure cannot
+    take down the headline metric line."""
+    extras = {}
+    if os.environ.get("BENCH_SKIP_EXTRAS") == "1":
+        return extras
+    for name, fn in (("llama", bench_llama_mfu), ("bert", bench_bert_mfu),
+                     ("flash_vs_xla", bench_flash_vs_xla)):
+        try:
+            t0 = time.time()
+            extras[name] = fn()
+            log("{} bench done in {:.1f}s: {}".format(
+                name, time.time() - t0, extras[name]))
+        except Exception as e:  # noqa: BLE001
+            extras[name] = {"error": repr(e)}
+            log("{} bench FAILED: {!r}".format(name, e))
+    return extras
 
 
 def main():
@@ -152,10 +347,12 @@ def main():
 
     log("devices: {}".format(jax.devices()))
 
-    # Warm-up: compile the two step shapes once so both measurements see a
-    # warm cache (the persistent compilation cache does this across runs).
+    # Warm-up: compile every step shape (one per batch choice) so both
+    # measurements see a warm cache (the persistent compilation cache does
+    # this across runs).
     t0 = time.time()
-    train_mnist(1e-3, budget=1)
+    for bs in BATCH_CHOICES:
+        train_mnist(1e-3, batch=bs, budget=0.2)
     log("warm-up done in {:.1f}s".format(time.time() - t0))
 
     result, wall = run_framework_sweep()
@@ -175,14 +372,17 @@ def main():
     for td in glob.glob(os.path.join(exp_dirs[-1], "*", "trial.json")):
         with open(td) as f:
             t = _json.load(f)
-        schedule.append((t.get("start") or 0,
-                         t["params"]["lr"], t["params"].get("budget", 1)))
+        schedule.append((t.get("start") or 0, t["params"]["lr"],
+                         t["params"].get("batch", 256),
+                         t["params"].get("budget", 1)))
     # Submission order (start timestamps): the order ASHA produced — rung-0
     # first, promotions late — is what a stage scheduler would see.
-    schedule = [(lr, b) for _, lr, b in sorted(schedule)]
+    schedule = [args[1:] for args in sorted(schedule)]
     seq_wall = run_wave_baseline(schedule)
     seq_trials_per_hour = len(schedule) / seq_wall * 3600
     log("wave baseline: {} trials in {:.1f}s".format(len(schedule), seq_wall))
+
+    extras = run_extra_benches()
 
     print(json.dumps({
         "metric": "ASHA trials/hour (MNIST CNN sweep, 1 chip, 3 concurrent runners)",
@@ -194,6 +394,7 @@ def main():
             "stage_based_baseline_wall_s": round(seq_wall, 1),
             "trials": n_runs,
             "early_stopped": result.get("early_stopped", 0),
+            **extras,
         },
     }))
 

@@ -320,6 +320,9 @@ class OptimizationDriver(Driver):
                 report(trial.trial_id)
                 self._checkpoint_pruner()
         self._update_result(trial)
+        # Persist BEFORE the hand-off: assignment of the last trial flips
+        # experiment_done and releases pool.run(), so a dump placed after it
+        # could still be in flight (or fail unobserved) when lagom returns.
         self.env.dump(trial.to_json(),
                       "{}/{}/trial.json".format(self.exp_dir, trial.trial_id))
         self._assign_next(msg["partition_id"], trial)

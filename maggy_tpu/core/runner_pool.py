@@ -71,6 +71,27 @@ class ThreadRunnerPool(RunnerPool):
         return errors
 
 
+def chip_env(index: int, chips_per_trial: int = 1) -> dict:
+    """Env vars pinning one runner to its disjoint TPU chip subset: runner
+    ``index`` sees chips [index*k, (index+1)*k). libtpu reads
+    TPU_VISIBLE_CHIPS before backend init — the TPU analogue of the
+    reference pinning one GPU per Spark executor. Shared by the local
+    TPURunnerPool (process pools) and the remote agent's --chips-per-agent
+    / --agent-index flags (one agent per chip subset on each pod VM).
+
+    TPU_VISIBLE_CHIPS alone defines the per-process sub-slice; libtpu
+    derives its bounds from the visible set, so forcing 1x1x1 bounds here
+    would contradict multi-chip trials.
+    """
+    chips = ",".join(str(c) for c in
+                     range(index * chips_per_trial,
+                           (index + 1) * chips_per_trial))
+    return {
+        "TPU_VISIBLE_CHIPS": chips,
+        "ALLOW_MULTIPLE_LIBTPU_LOAD": "1",
+    }
+
+
 def _process_entry(worker_fn, pid, chip_env):
     # Device pinning must precede any jax import in the child.
     for k, v in (chip_env or {}).items():
@@ -131,18 +152,9 @@ class TPURunnerPool(ProcessRunnerPool):
                 "host.".format(num_workers, chips_per_trial, total_chips)
             )
 
-        def chip_env(i: int) -> dict:
-            k = chips_per_trial
-            chips = ",".join(str(c) for c in range(i * k, (i + 1) * k))
-            # TPU_VISIBLE_CHIPS alone defines the per-process sub-slice;
-            # libtpu derives its bounds from the visible set, so forcing
-            # 1x1x1 bounds here would contradict multi-chip trials.
-            return {
-                "TPU_VISIBLE_CHIPS": chips,
-                "ALLOW_MULTIPLE_LIBTPU_LOAD": "1",
-            }
-
-        super().__init__(num_workers, start_method="spawn", chip_env_fn=chip_env)
+        super().__init__(
+            num_workers, start_method="spawn",
+            chip_env_fn=lambda i: chip_env(i, chips_per_trial))
         self.chips_per_trial = chips_per_trial
         self.total_chips = total_chips
 

@@ -554,6 +554,15 @@ def _tpu_backend() -> bool:
         return False
 
 
+def _flash_disabled() -> bool:
+    """Operational kill switch: MAGGY_TPU_NO_FLASH=1 forces the XLA
+    reference path everywhere (e.g. to isolate a Mosaic regression on a new
+    libtpu without touching code)."""
+    import os
+
+    return os.environ.get("MAGGY_TPU_NO_FLASH") == "1"
+
+
 def _key_padding_mask(mask, B, Sk):
     """Reduce an attention mask to a [B, Sk] keep-mask, or (None, False)
     when it cannot be PROVEN key-padding-only. Only the unambiguous forms
@@ -605,7 +614,8 @@ def multi_head_attention(q, k, v, causal: bool = True, mask=None,
                     D, Sq, Sk, None if mask is None else jnp.shape(mask)))
         use_flash = True
     else:
-        use_flash = force is None and _tpu_backend() and tiles_ok
+        use_flash = force is None and _tpu_backend() and tiles_ok \
+            and not _flash_disabled()
     if not use_flash:
         return attention_reference(q, k, v, causal=causal, mask=mask)
     interpret = not _tpu_backend()

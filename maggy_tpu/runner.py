@@ -166,7 +166,29 @@ def main(argv=None) -> int:
                    help="reclaim a specific runner slot (restart recovery)")
     p.add_argument("--profile", action="store_true",
                    help="capture a jax.profiler trace per trial")
+    p.add_argument("--chips-per-agent", type=int, default=None,
+                   help="pin this agent to a disjoint TPU chip subset of "
+                        "its host: agent sees chips [agent-index*K, "
+                        "(agent-index+1)*K). Launch one agent per subset "
+                        "on each pod VM for per-trial chip parallelism.")
+    p.add_argument("--agent-index", type=int, default=0,
+                   help="this agent's index AMONG THE AGENTS ON THIS HOST "
+                        "(0..hosts_agents-1); selects its chip subset")
     args = p.parse_args(argv)
+
+    if args.chips_per_agent is not None:
+        # Must precede the first jax/libtpu initialization in this process
+        # (the executor's first device touch) — same pinning the local
+        # TPURunnerPool applies to its spawned processes.
+        from maggy_tpu.core.runner_pool import chip_env
+
+        if args.chips_per_agent <= 0:
+            p.error("--chips-per-agent must be >= 1")
+        if args.agent_index < 0:
+            p.error("--agent-index must be >= 0")
+        for key, value in chip_env(args.agent_index,
+                                   args.chips_per_agent).items():
+            os.environ[key] = value
 
     if args.ticket:
         ticket = read_ticket(args.ticket, wait_s=args.wait_ticket)

@@ -12,6 +12,25 @@ def train_fn(lr, units, reporter=None):
     return {"metric": acc}
 
 
+def pinned_train_fn(lr, units, reporter=None):
+    """Records which chip subset this agent was pinned to (chip-pinning
+    e2e: the flag must land in the env BEFORE the trial runs)."""
+    import os
+
+    import time
+
+    marker_dir = os.environ["MAGGY_TEST_PIN_DIR"]
+    pin = os.environ.get("TPU_VISIBLE_CHIPS", "unpinned")
+    host = os.environ.get("MAGGY_TEST_HOST", "h?")
+    with open(os.path.join(marker_dir, "{}_{}".format(host, pin.replace(",", "-"))),
+              "a") as f:
+        f.write("{}\n".format(os.getpid()))
+    # Slow trials so the schedule spreads over ALL agents (the pin
+    # assertions need every chip subset to see work).
+    time.sleep(0.2)
+    return {"metric": 1.0 - (lr - 0.1) ** 2}
+
+
 def dist_train_fn(sharding_env, reporter=None):
     """One SPMD worker: proves the cross-process world actually formed and
     that a collective runs over it."""

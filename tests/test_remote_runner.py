@@ -186,6 +186,76 @@ class TestRemoteDistributedE2E:
         assert result["average_metric"] == 0.5
 
 
+class TestChipPinnedAgents:
+    def test_two_hosts_two_pinned_agents_each(self, local_env, tmp_path,
+                                              monkeypatch):
+        """The v4-32 north-star launch shape, simulated at the env-var
+        level: 2 "hosts" x 2 agents each, every agent started with
+        --chips-per-agent 2 --agent-index {0,1}. Each agent must see its
+        own TPU_VISIBLE_CHIPS subset before the trial runs (libtpu reads
+        it at backend init; here JAX runs on CPU so the variable is inert
+        but its propagation path is identical)."""
+        pin_dir = tmp_path / "pins"
+        pin_dir.mkdir()
+        config = OptimizationConfig(
+            name="pinned", num_trials=12, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2]),
+                                    units=("INTEGER", [8, 64])),
+            direction="max", num_workers=4, hb_interval=0.1, seed=13,
+            es_policy="none", pool="remote", bind_host="127.0.0.1",
+        )
+        result_box = {}
+
+        def drive():
+            result_box["result"] = experiment.lagom(
+                load_train_fn("remote_train_module:pinned_train_fn"), config)
+
+        driver_thread = threading.Thread(target=drive, daemon=True)
+        driver_thread.start()
+
+        ticket_path = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and ticket_path is None:
+            hits = glob.glob(str(tmp_path / "exp" / "*" / "runner_ticket.json"))
+            if hits:
+                ticket_path = hits[0]
+            time.sleep(0.1)
+        assert ticket_path, "driver never published runner_ticket.json"
+
+        base_env = dict(os.environ)
+        base_env["PYTHONPATH"] = TESTS_DIR + os.pathsep + base_env.get(
+            "PYTHONPATH", "")
+        base_env.setdefault("JAX_PLATFORMS", "cpu")
+        base_env["MAGGY_TEST_PIN_DIR"] = str(pin_dir)
+        agents = []
+        for host in ("hostA", "hostB"):          # per-VM launch one-liner:
+            for agent_index in (0, 1):           # one agent per chip subset
+                env = dict(base_env, MAGGY_TEST_HOST=host)
+                agents.append(subprocess.Popen(
+                    [sys.executable, "-m", "maggy_tpu.runner",
+                     "--ticket", ticket_path,
+                     "--train", "remote_train_module:pinned_train_fn",
+                     "--chips-per-agent", "2",
+                     "--agent-index", str(agent_index)],
+                    env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT))
+        for a in agents:
+            out, _ = a.communicate(timeout=120)
+            assert a.returncode == 0, out.decode()
+        driver_thread.join(timeout=60)
+        assert not driver_thread.is_alive()
+        assert result_box["result"]["num_trials"] == 12
+
+        pins = sorted(os.listdir(pin_dir))
+        # Agent index 0 -> chips 0,1; index 1 -> chips 2,3; on both hosts.
+        expected = {"hostA_0-1", "hostA_2-3", "hostB_0-1", "hostB_2-3"}
+        assert set(pins) <= expected
+        # BOTH distinct chip subsets must have seen work — this is the
+        # assertion that fails if --agent-index stops reaching chip_env.
+        assert any(p.endswith("0-1") for p in pins), pins
+        assert any(p.endswith("2-3") for p in pins), pins
+
+
 class TestAllAgentsDead:
     def test_driver_fails_instead_of_hanging(self, local_env, tmp_path):
         """Every remote agent dying silently must FAIL the experiment, not
