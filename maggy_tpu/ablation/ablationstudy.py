@@ -131,11 +131,18 @@ class AblationStudy:
         training_dataset_version: int = 1,
         label_name: str = "",
         dataset_generator: Optional[Callable] = None,
+        train_set: Any = None,
     ):
         self.name = training_dataset_name
         self.version = training_dataset_version
         self.label_name = label_name
         self.custom_dataset_generator = dataset_generator
+        #: Built-in feature dropping (the local analogue of the reference's
+        #: feature-store read, `loco.py:41-80`): a dict of arrays or a
+        #: path (.npz/.parquet/parquet dir). When set and no custom
+        #: generator is given, each trial's dataset_function returns this
+        #: data minus the ablated feature.
+        self.train_set = train_set
         self.features = Features()
         self.model = Model()
 

@@ -109,11 +109,22 @@ def resolve_ablation_params(study, params: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def default_dataset_generator(study, ablated_feature: Optional[str] = None):
-    """Fallback dataset generator: requires the study to have been given a
-    custom one; kept as an explicit error path (the reference reads the
-    Hopsworks feature store here, `loco.py:41-80`, which has no local
-    analogue)."""
-    raise ValueError(
-        "No dataset generator: pass dataset_generator= to AblationStudy "
-        "(feature-store reads are not available outside a platform env)."
-    )
+    """Built-in feature dropping from the study's ``train_set`` (dict of
+    arrays or an .npz/.parquet path) — the local analogue of the reference
+    reading the feature store minus the ablated feature (`loco.py:41-80`)."""
+    src = getattr(study, "train_set", None)
+    if src is None:
+        raise ValueError(
+            "No dataset source: pass train_set= (dict of arrays or a "
+            "dataset path) or dataset_generator= to AblationStudy."
+        )
+    from maggy_tpu.train.data import feature_dropping_generator
+
+    # Cache the generator (and its loaded-path data) per SOURCE, so
+    # reassigning study.train_set between runs rebuilds instead of silently
+    # serving the previous dataset.
+    cached = study.__dict__.get("_feature_dropping_cache")
+    if cached is None or cached[0] is not src:
+        cached = (src, feature_dropping_generator(src))
+        study.__dict__["_feature_dropping_cache"] = cached
+    return cached[1](ablated_feature=ablated_feature)

@@ -193,6 +193,46 @@ def prefetch_iterator(iterator, size: int = 2):
         stop.set()
 
 
+def drop_feature(data: Dict[str, Any], feature: Optional[str]) -> Dict[str, Any]:
+    """Dict-of-arrays minus one column — the LOCO dataset ablation step
+    (the reference drops the ablated feature from the training-dataset
+    schema itself, `loco.py:41-80`). Returns a new dict whose values ALIAS
+    the input arrays (shallow); `feature_dropping_generator` adds the
+    per-trial copies. An unknown feature raises: silently "dropping"
+    nothing would corrupt the study's comparison."""
+    if feature is None:
+        return dict(data)
+    if feature not in data:
+        raise KeyError(
+            "Ablated feature {!r} is not a column of the dataset "
+            "(have: {}).".format(feature, sorted(data)))
+    return {k: v for k, v in data.items() if k != feature}
+
+
+def feature_dropping_generator(source):
+    """Build a LOCO ``dataset_generator``: ``gen(ablated_feature=None)``
+    returns the training data as a dict of arrays minus the ablated
+    feature. ``source`` is a dict of arrays or a path `load_path_dataset`
+    understands (.npz / .parquet / parquet dir); paths are loaded once per
+    process and cached across the study's trials. Each call returns FRESH
+    array copies — trials routinely normalize in place, and aliased arrays
+    would leak one trial's mutations into every other (concurrent
+    in-process runners share this generator)."""
+    cache = {}
+
+    def generator(ablated_feature: Optional[str] = None):
+        if isinstance(source, str):
+            if "data" not in cache:
+                cache["data"] = load_path_dataset(source)
+            data = cache["data"]
+        else:
+            data = source
+        return {k: np.array(v, copy=True)
+                for k, v in drop_feature(data, ablated_feature).items()}
+
+    return generator
+
+
 def load_path_dataset(path, columns=None, file_shard=None):
     """Load an on-disk dataset into a dict of numpy arrays.
 

@@ -97,6 +97,64 @@ class TestLocoSchedule:
         assert "dense_1" in resolved["model_function"]()["layers"]
 
 
+class TestFeatureDropping:
+    """Built-in dataset ablation (the reference drops the ablated feature
+    from the dataset schema itself, `loco.py:41-80`): AblationStudy
+    (train_set=...) needs no custom generator."""
+
+    def _data(self):
+        import numpy as np
+
+        return {"age": np.arange(4.0), "fare": np.arange(4.0) * 2,
+                "sex": np.zeros(4), "label": np.ones(4)}
+
+    def test_drop_feature(self):
+        from maggy_tpu.train.data import drop_feature
+
+        data = self._data()
+        out = drop_feature(data, "fare")
+        assert sorted(out) == ["age", "label", "sex"]
+        assert sorted(drop_feature(data, None)) == sorted(data)
+        with pytest.raises(KeyError, match="cabin"):
+            drop_feature(data, "cabin")
+
+    def test_generator_from_dict_and_path(self, tmp_path):
+        import numpy as np
+
+        from maggy_tpu.train.data import feature_dropping_generator
+
+        gen = feature_dropping_generator(self._data())
+        assert "age" not in gen(ablated_feature="age")
+        path = tmp_path / "ds.npz"
+        np.savez(path, **self._data())
+        gen = feature_dropping_generator(str(path))
+        out = gen(ablated_feature="sex")
+        assert sorted(out) == ["age", "fare", "label"]
+        assert list(out["fare"]) == [0.0, 2.0, 4.0, 6.0]
+
+    def test_default_generator_uses_train_set(self):
+        study = AblationStudy("toy", 1, "label", train_set=self._data())
+        study.features.include("age", "fare")
+        study.model.set_base_model_generator(toy_model_generator)
+        loco = LOCO(study)
+        loco.initialize()
+        resolver = loco.make_resolver()
+        trial = [t for t in [loco.get_trial()
+                             for _ in range(loco.get_number_of_trials())]
+                 if t and t.params["ablated_feature"] == "fare"][0]
+        resolved = resolver(dict(trial.params))
+        data = resolved["dataset_function"]()
+        assert sorted(data) == ["age", "label", "sex"]
+
+    def test_no_source_raises(self):
+        study = AblationStudy("toy", 1, "label")
+        study.model.set_base_model_generator(toy_model_generator)
+        from maggy_tpu.ablation.ablator.loco import default_dataset_generator
+
+        with pytest.raises(ValueError, match="train_set"):
+            default_dataset_generator(study, "age")
+
+
 def ablation_train_fn(dataset_function, model_function, ablated_feature,
                       ablated_layer, reporter=None):
     data = dataset_function()
