@@ -563,6 +563,46 @@ def _flash_disabled() -> bool:
     return os.environ.get("MAGGY_TPU_NO_FLASH") == "1"
 
 
+_FLASH_PROBE: Optional[bool] = None
+
+
+def _flash_compiles() -> bool:
+    """One-time compile probe of the Pallas kernels on the live backend.
+
+    Auto-dispatch must not brick every attention model if a libtpu/Mosaic
+    update rejects a kernel layout: probe a tiny flash call once per
+    process; on failure warn LOUDLY and fall back to XLA attention
+    (force="flash" still surfaces the real compile error). The probe
+    lowers an independent jit, so it is safe to run while an outer model
+    step is being traced."""
+    global _FLASH_PROBE
+    if _FLASH_PROBE is None:
+        try:
+            q = jnp.zeros((1, 128, 2, 128), jnp.bfloat16)
+            kv = jnp.zeros((1, 128, 1, 128), jnp.bfloat16)
+            mask = jnp.ones((1, 128), jnp.int32)
+
+            def probe(q, k, v, m):
+                # Cover every kernel auto-dispatch can reach: masked AND
+                # mask-free forwards (distinct specializations), and — via
+                # grad — both backward kernels in each variant.
+                return (jnp.sum(flash_attention(q, k, v, m, True) ** 2)
+                        + jnp.sum(flash_attention(q, k, v, None, True) ** 2))
+
+            jax.jit(jax.grad(probe, (0, 1, 2))).lower(q, kv, kv, mask).compile()
+            _FLASH_PROBE = True
+        except Exception as e:  # noqa: BLE001
+            import warnings
+
+            warnings.warn(
+                "Pallas flash attention failed to COMPILE on backend {!r}; "
+                "falling back to XLA reference attention everywhere "
+                "(error: {!r})".format(jax.default_backend(), e),
+                stacklevel=2)
+            _FLASH_PROBE = False
+    return _FLASH_PROBE
+
+
 def _key_padding_mask(mask, B, Sk):
     """Reduce an attention mask to a [B, Sk] keep-mask, or (None, False)
     when it cannot be PROVEN key-padding-only. Only the unambiguous forms
@@ -615,7 +655,7 @@ def multi_head_attention(q, k, v, causal: bool = True, mask=None,
         use_flash = True
     else:
         use_flash = force is None and _tpu_backend() and tiles_ok \
-            and not _flash_disabled()
+            and not _flash_disabled() and _flash_compiles()
     if not use_flash:
         return attention_reference(q, k, v, causal=causal, mask=mask)
     interpret = not _tpu_backend()

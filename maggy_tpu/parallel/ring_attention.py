@@ -262,13 +262,14 @@ def ring_attention(q, k, v, mesh, axis_name: str = "seq",
                          .format(S, n, axis_name))
     if H % Hkv:
         raise ValueError("H={} not divisible by Hkv={}".format(H, Hkv))
-    from maggy_tpu.ops.attention import _flash_disabled
+    from maggy_tpu.ops.attention import _flash_compiles, _flash_disabled
 
     shard = S // n
     flash_ok = shard % 128 == 0 and D >= 64 and D % 8 == 0
     if impl == "auto":
-        impl = "flash" if flash_ok and (_tpu_backend() or interpret) \
-            and not _flash_disabled() else "xla"
+        impl = "flash" if flash_ok and not _flash_disabled() \
+            and (interpret or (_tpu_backend() and _flash_compiles())) \
+            else "xla"
     if impl == "flash" and not flash_ok:
         raise ValueError(
             "impl='flash' needs S/n divisible by 128 and D>=64 with D%8==0; "
