@@ -140,6 +140,35 @@ def log(msg):
     print("[bench] {}".format(msg), file=sys.stderr, flush=True)
 
 
+def handoff_gaps(trials):
+    """Per-partition trial hand-off gaps from loaded trial.json dicts:
+    time from one trial's end (start+duration) to the SAME runner's next
+    trial start. This is the control plane's per-trial overhead — the
+    number that must stay in the low milliseconds (BASELINE.md's <50 ms
+    budget). Gaps spanning rung-barrier idle waits are excluded by capping
+    at 2 s (idling on purpose is scheduling, not overhead)."""
+    by_partition = {}
+    for t in trials:
+        pid = (t.get("info_dict") or {}).get("partition")
+        if pid is None or t.get("start") is None or t.get("duration") is None:
+            continue
+        by_partition.setdefault(pid, []).append(
+            (t["start"], t["start"] + t["duration"]))
+    gaps = []
+    for runs in by_partition.values():
+        runs.sort()
+        for (s0, e0), (s1, _) in zip(runs, runs[1:]):
+            gap = s1 - e0
+            if 0 <= gap < 2.0:
+                gaps.append(gap * 1e3)
+    if not gaps:
+        return {}
+    gaps.sort()
+    return {"median_ms": round(gaps[len(gaps) // 2], 1),
+            "p95_ms": round(gaps[int(len(gaps) * 0.95)], 1),
+            "n": len(gaps)}
+
+
 # ------------------------------------------------------------- MFU + kernels
 
 # Peak bf16 matmul throughput per chip, by device_kind prefix.
@@ -368,16 +397,20 @@ def main():
 
     exp_dirs = sorted(glob.glob(os.path.join(
         os.environ["MAGGY_TPU_BASE_DIR"], "*")))
-    schedule = []
+    trial_dicts = []
     for td in glob.glob(os.path.join(exp_dirs[-1], "*", "trial.json")):
         with open(td) as f:
-            t = _json.load(f)
-        schedule.append((t.get("start") or 0, t["params"]["lr"],
-                         t["params"].get("batch", 256),
-                         t["params"].get("budget", 1)))
+            trial_dicts.append(_json.load(f))
+    schedule = [(t.get("start") or 0, t["params"]["lr"],
+                 t["params"].get("batch", 256),
+                 t["params"].get("budget", 1)) for t in trial_dicts]
     # Submission order (start timestamps): the order ASHA produced — rung-0
     # first, promotions late — is what a stage scheduler would see.
     schedule = [args[1:] for args in sorted(schedule)]
+    handoff = handoff_gaps(trial_dicts)
+    if handoff:
+        log("hand-off gap ms: median {} p95 {} (n={})".format(
+            handoff["median_ms"], handoff["p95_ms"], handoff["n"]))
     seq_wall = run_wave_baseline(schedule)
     seq_trials_per_hour = len(schedule) / seq_wall * 3600
     log("wave baseline: {} trials in {:.1f}s".format(len(schedule), seq_wall))
@@ -394,6 +427,7 @@ def main():
             "stage_based_baseline_wall_s": round(seq_wall, 1),
             "trials": n_runs,
             "early_stopped": result.get("early_stopped", 0),
+            "handoff": handoff,
             **extras,
         },
     }))

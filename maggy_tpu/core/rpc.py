@@ -508,8 +508,13 @@ class OptimizationServer(Server):
             return {"type": "OK", "trial_id": None}
         trial.set_status(Trial.RUNNING)
         trial.start = time.time()
+        # Which runner served it: lets offline analysis (bench.py) compute
+        # true per-partition hand-off gaps from the trial.json artifacts.
+        with trial.lock:
+            trial.info_dict["partition"] = msg["partition_id"]
+            info = dict(trial.info_dict)
         return {"type": "TRIAL", "trial_id": trial.trial_id,
-                "params": trial.params, "info": dict(trial.info_dict)}
+                "params": trial.params, "info": info}
 
     def _log(self, msg):
         return {"type": "LOG", **self.driver.progress_snapshot()}
