@@ -340,21 +340,42 @@ def bench_flash_vs_xla():
 
 
 def run_extra_benches():
-    """MFU + kernel measurements; each is best-effort so a failure cannot
-    take down the headline metric line."""
+    """MFU + kernel measurements; each is best-effort AND time-bounded so
+    neither a failure nor a hang (compile stall, OOM thrash) can take down
+    the headline metric line."""
+    import signal
+
     extras = {}
     if os.environ.get("BENCH_SKIP_EXTRAS") == "1":
         return extras
+    budget_s = int(os.environ.get("BENCH_EXTRA_TIMEOUT_S", "300"))
+
+    class _Timeout(Exception):
+        pass
+
+    def _raise(signum, frame):
+        raise _Timeout("exceeded {}s".format(budget_s))
+
     for name, fn in (("llama", bench_llama_mfu), ("bert", bench_bert_mfu),
                      ("flash_vs_xla", bench_flash_vs_xla)):
+        old = signal.signal(signal.SIGALRM, _raise)
+        signal.alarm(budget_s)
         try:
             t0 = time.time()
-            extras[name] = fn()
+            result = fn()
+            # Cancel IMMEDIATELY: a late alarm firing during the log call
+            # below would escape this try and kill the headline output.
+            signal.alarm(0)
+            extras[name] = result
             log("{} bench done in {:.1f}s: {}".format(
-                name, time.time() - t0, extras[name]))
-        except Exception as e:  # noqa: BLE001
+                name, time.time() - t0, result))
+        except Exception as e:  # noqa: BLE001 - incl. _Timeout; KI/SystemExit propagate
+            signal.alarm(0)
             extras[name] = {"error": repr(e)}
             log("{} bench FAILED: {!r}".format(name, e))
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
     return extras
 
 
