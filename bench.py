@@ -140,6 +140,12 @@ def log(msg):
     print("[bench] {}".format(msg), file=sys.stderr, flush=True)
 
 
+# Set when an extra bench was abandoned mid-native-call: interpreter
+# teardown with that thread alive aborts (pybind exception across exit), so
+# main() hard-exits after flushing instead.
+_ABANDONED_WORKER = False
+
+
 def handoff_gaps(trials):
     """Per-partition trial hand-off gaps from loaded trial.json dicts:
     time from one trial's end (start+duration) to the SAME runner's next
@@ -229,6 +235,10 @@ def bench_llama_mfu():
         num_layers=int(os.environ.get("BENCH_LLAMA_LAYERS", "8")),
         num_heads=16, num_kv_heads=8, head_dim=128, max_seq_len=S,
         dtype=jnp.bfloat16,
+        # No rematerialization: activations at this size fit HBM, and remat
+        # would recompute the forward (real FLOPs ~8NP vs the 6NP counted),
+        # understating MFU.
+        remat=False,
     )
     mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
     model = Llama(cfg)
@@ -340,42 +350,51 @@ def bench_flash_vs_xla():
 
 
 def run_extra_benches():
-    """MFU + kernel measurements; each is best-effort AND time-bounded so
-    neither a failure nor a hang (compile stall, OOM thrash) can take down
-    the headline metric line."""
-    import signal
+    """MFU + kernel measurements; each is best-effort AND wall-clock
+    bounded so neither a failure nor a hang (compile stall, OOM thrash,
+    wedged device op) can take down the headline metric line. Each bench
+    runs on a daemon worker thread joined with a timeout: a stall inside
+    native XLA code cannot be interrupted, but the main thread walks away
+    and still prints the headline JSON (a signal-based timeout could not
+    deliver that — CPython only raises between bytecodes). After one
+    timeout the remaining benches are skipped: they share the (possibly
+    wedged) device."""
+    import threading
 
     extras = {}
     if os.environ.get("BENCH_SKIP_EXTRAS") == "1":
         return extras
-    budget_s = int(os.environ.get("BENCH_EXTRA_TIMEOUT_S", "300"))
-
-    class _Timeout(Exception):
-        pass
-
-    def _raise(signum, frame):
-        raise _Timeout("exceeded {}s".format(budget_s))
+    budget_s = float(os.environ.get("BENCH_EXTRA_TIMEOUT_S", "300"))
 
     for name, fn in (("llama", bench_llama_mfu), ("bert", bench_bert_mfu),
                      ("flash_vs_xla", bench_flash_vs_xla)):
-        old = signal.signal(signal.SIGALRM, _raise)
-        signal.alarm(budget_s)
-        try:
-            t0 = time.time()
-            result = fn()
-            # Cancel IMMEDIATELY: a late alarm firing during the log call
-            # below would escape this try and kill the headline output.
-            signal.alarm(0)
-            extras[name] = result
+        box = {}
+
+        def target(fn=fn, box=box):
+            try:
+                box["result"] = fn()
+            except Exception as e:  # noqa: BLE001
+                box["error"] = e
+
+        t0 = time.time()
+        worker = threading.Thread(target=target, daemon=True,
+                                  name="bench-{}".format(name))
+        worker.start()
+        worker.join(budget_s)
+        if worker.is_alive():
+            global _ABANDONED_WORKER
+            _ABANDONED_WORKER = True
+            extras[name] = {"error": "timeout: still running after {}s".format(budget_s)}
+            log("{} bench TIMED OUT after {}s; skipping remaining extra "
+                "benches (device may be wedged)".format(name, budget_s))
+            break
+        if "error" in box:
+            extras[name] = {"error": repr(box["error"])}
+            log("{} bench FAILED: {!r}".format(name, box["error"]))
+        else:
+            extras[name] = box["result"]
             log("{} bench done in {:.1f}s: {}".format(
-                name, time.time() - t0, result))
-        except Exception as e:  # noqa: BLE001 - incl. _Timeout; KI/SystemExit propagate
-            signal.alarm(0)
-            extras[name] = {"error": repr(e)}
-            log("{} bench FAILED: {!r}".format(name, e))
-        finally:
-            signal.alarm(0)
-            signal.signal(signal.SIGALRM, old)
+                name, time.time() - t0, box["result"]))
     return extras
 
 
@@ -451,7 +470,12 @@ def main():
             "handoff": handoff,
             **extras,
         },
-    }))
+    }), flush=True)
+    if _ABANDONED_WORKER:
+        # Skip interpreter teardown: a worker wedged inside a native XLA
+        # call would abort the process AFTER the JSON already printed.
+        sys.stderr.flush()
+        os._exit(0)
 
 
 if __name__ == "__main__":

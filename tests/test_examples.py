@@ -34,6 +34,8 @@ def run_example(tmp_path, name, *args, timeout=150):
     ("mnist_hpo.py", ("--trials", "2", "--workers", "2")),
     ("bert_glue_hpo.py", ("--trials", "2")),
     ("llama_lora_sweep.py", ("--trials", "2", "--resource-max", "1")),
+    ("resnet_cifar_asha.py", ("--trials", "2", "--resource-max", "1",
+                              "--workers", "2")),
     ("titanic_ablation.py", ()),
     ("distributed_training.py", ()),
 ])
