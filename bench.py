@@ -364,10 +364,21 @@ def run_extra_benches():
     extras = {}
     if os.environ.get("BENCH_SKIP_EXTRAS") == "1":
         return extras
-    budget_s = float(os.environ.get("BENCH_EXTRA_TIMEOUT_S", "300"))
+    budget_s = float(os.environ.get("BENCH_EXTRA_TIMEOUT_S", "240"))
+    # Overall cap across all extras: the driver bounds the whole bench run,
+    # and losing the headline metric to slow extras would invert priorities.
+    total_s = float(os.environ.get("BENCH_EXTRA_TOTAL_S", "480"))
+    started = time.time()
 
-    for name, fn in (("llama", bench_llama_mfu), ("bert", bench_bert_mfu),
-                     ("flash_vs_xla", bench_flash_vs_xla)):
+    benches = [("llama", bench_llama_mfu), ("bert", bench_bert_mfu),
+               ("flash_vs_xla", bench_flash_vs_xla)]
+    for i, (name, fn) in enumerate(benches):
+        remaining = total_s - (time.time() - started)
+        if remaining <= 5:
+            extras[name] = {"error": "skipped: extras total budget spent"}
+            log("{} bench skipped (total extras budget {}s spent)".format(
+                name, total_s))
+            continue
         box = {}
 
         def target(fn=fn, box=box):
@@ -380,13 +391,17 @@ def run_extra_benches():
         worker = threading.Thread(target=target, daemon=True,
                                   name="bench-{}".format(name))
         worker.start()
-        worker.join(budget_s)
+        waited = min(budget_s, remaining)
+        worker.join(waited)
         if worker.is_alive():
             global _ABANDONED_WORKER
             _ABANDONED_WORKER = True
-            extras[name] = {"error": "timeout: still running after {}s".format(budget_s)}
-            log("{} bench TIMED OUT after {}s; skipping remaining extra "
-                "benches (device may be wedged)".format(name, budget_s))
+            extras[name] = {"error": "timeout: still running after {:.0f}s".format(waited)}
+            for later, _ in benches[i + 1:]:
+                extras[later] = {"error": "skipped: {} timed out (device may "
+                                          "be wedged)".format(name)}
+            log("{} bench TIMED OUT after {:.0f}s; skipping remaining extra "
+                "benches (device may be wedged)".format(name, waited))
             break
         if "error" in box:
             extras[name] = {"error": repr(box["error"])}
