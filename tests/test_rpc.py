@@ -266,6 +266,97 @@ class TestHeartbeatLoss:
         assert not any(m["type"] == "LOST" for m in driver.messages)
 
 
+class TestLazyMetrics:
+    """Reporter accepts device scalars and materializes them OFF the
+    training thread (on the heartbeat path) — the mechanism that keeps the
+    trial's step stream pipelined over a high-latency device link."""
+
+    def test_broadcast_device_scalar_materializes_in_get_data(self):
+        import jax.numpy as jnp
+
+        rep = Reporter()
+        rep.reset(trial_id="t")
+        rep.broadcast(jnp.asarray(0.75), step=0)
+        # Stored lazily (not yet a float)...
+        assert not isinstance(rep.metric, float)
+        data = rep.get_data()
+        # ...but the wire sees a plain float (msgpack-serializable).
+        assert isinstance(data["metric"], float)
+        assert data["metric"] == pytest.approx(0.75)
+
+    def test_materialization_is_identity_cached(self, monkeypatch):
+        import jax.numpy as jnp
+
+        rep = Reporter()
+        rep.reset(trial_id="t")
+        value = jnp.asarray(1.5)
+        rep.broadcast(value, step=0)
+        assert rep.get_data()["metric"] == pytest.approx(1.5)
+        assert rep._metric_cache[0] is value
+        # Second drain of the SAME value must hit the cache — no re-sync.
+        monkeypatch.setattr(
+            Reporter, "_materialize",
+            staticmethod(lambda m: pytest.fail("re-materialized cached value")))
+        assert rep.get_data()["metric"] == pytest.approx(1.5)
+
+    def test_lazy_metric_travels_heartbeat_to_driver(self, opt_server):
+        import jax.numpy as jnp
+
+        server, driver, addr = opt_server
+        trial = Trial({"lr": 0.1})
+        driver.trials[trial.trial_id] = trial
+        client = make_client(addr, server, hb=0.05)
+        client.register()
+        reporter = Reporter()
+        reporter.reset(trial_id=trial.trial_id)
+        client.start_heartbeat(reporter)
+        reporter.broadcast(jnp.asarray(0.25), step=0)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            metrics = [m for m in driver.messages
+                       if m["type"] == "METRIC" and m.get("value") is not None]
+            if metrics:
+                assert metrics[-1]["value"] == pytest.approx(0.25)
+                assert isinstance(metrics[-1]["value"], float)
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("lazy metric never reached the driver")
+        client.stop()
+
+    def test_multi_element_arrays_rejected(self):
+        import jax.numpy as jnp
+
+        from maggy_tpu.exceptions import BroadcastMetricTypeError
+
+        rep = Reporter()
+        rep.reset(trial_id="t")
+        with pytest.raises(BroadcastMetricTypeError):
+            rep.broadcast(jnp.zeros((2,)), step=0)
+
+    def test_tracers_rejected_at_broadcast(self):
+        """broadcast from INSIDE jit must fail in the user's thread, not
+        later on the heartbeat thread at materialization time."""
+        import jax
+
+        from maggy_tpu.exceptions import BroadcastMetricTypeError
+
+        rep = Reporter()
+        rep.reset(trial_id="t")
+        caught = {}
+
+        @jax.jit
+        def step(x):
+            try:
+                rep.broadcast(x, step=0)
+            except BroadcastMetricTypeError:
+                caught["yes"] = True
+            return x
+
+        step(jax.numpy.asarray(1.0))
+        assert caught.get("yes")
+
+
 class TestJoinAdmission:
     """JOIN double-admission race (explicit-pid path): two agents JOINing
     the same pid before the first REGs must not both be admitted."""
