@@ -24,15 +24,29 @@ from maggy_tpu.core.runner_pool import ThreadRunnerPool
 from maggy_tpu.earlystop import MedianStoppingRule, NoStoppingRule
 from maggy_tpu.optimizers import Asha, GridSearch, RandomSearch, SingleRun
 from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
-from maggy_tpu.optimizers.bayes import GP, TPE
 from maggy_tpu.trial import Trial
 
+
+def _lazy_gp(**kwargs):
+    from maggy_tpu.optimizers.bayes import GP
+
+    return GP(**kwargs)
+
+
+def _lazy_tpe(**kwargs):
+    from maggy_tpu.optimizers.bayes import TPE
+
+    return TPE(**kwargs)
+
+
+# "gp"/"tpe" resolve lazily: the BO stack pulls sklearn/scipy (~2.5 s of
+# import), which must not tax experiments that never use it.
 CONTROLLER_REGISTRY = {
     "randomsearch": RandomSearch,
     "gridsearch": GridSearch,
     "asha": Asha,
-    "tpe": TPE,
-    "gp": GP,
+    "tpe": _lazy_tpe,
+    "gp": _lazy_gp,
     "none": SingleRun,
 }
 

@@ -28,7 +28,8 @@ class Reporter:
         self._log_buffer: List[str] = []
         self._log_file = log_file
         self._print_tee = print_tee
-        self._metric_cache = None  # (device_array, float) identity pair
+        self._metric_cache = None  # (device_array, float, step) identity triple
+        self._async_kick = None  # device array with an in-flight D2H copy
 
     # ------------------------------------------------------------- user API
 
@@ -110,7 +111,8 @@ class Reporter:
 
     def get_data(self) -> Dict[str, Any]:
         with self.lock:
-            metric, step = self.metric, self.step
+            metric, step, tid = self.metric, self.step, self.trial_id
+            cached = self._metric_cache
         if metric is not None and not isinstance(metric, float):
             # Materialize OUTSIDE the lock: the device sync (~50 ms over a
             # tunneled chip) must not block the training thread's broadcast.
@@ -118,17 +120,44 @@ class Reporter:
             # don't re-fetch. Runs BEFORE the log drain below — if the
             # device value is poisoned and float() raises, the buffered
             # logs stay queued for the next beat instead of vanishing.
-            cached = self._metric_cache
+            #
+            # NON-BLOCKING: if the step producing the value hasn't finished,
+            # don't park the heartbeat thread on it (concurrent blocking
+            # fetches from N runner heartbeats contend on the device link) —
+            # kick an async D2H copy and ship the previous materialized
+            # (metric, step) pair this beat; the driver dedups by step.
             if cached is not None and cached[0] is metric:
                 metric = cached[1]
             else:
-                value = self._materialize(metric)
-                self._metric_cache = (metric, value)
-                metric = value
+                try:
+                    ready = metric.is_ready()
+                    if not ready and self._async_kick is not metric:
+                        metric.copy_to_host_async()
+                        self._async_kick = metric
+                except AttributeError:  # 0-d numpy etc.: materialize now
+                    ready = True
+                if ready:
+                    value = self._materialize(metric)
+                    with self.lock:
+                        # Only cache if the trial hasn't rolled over while
+                        # materializing: a write landing after reset() would
+                        # resurrect THIS trial's value into the next trial's
+                        # ship-previous-pair branch below.
+                        if self.trial_id == tid:
+                            self._metric_cache = (metric, value, step)
+                            self._async_kick = None
+                    metric = value
+                elif cached is not None:
+                    metric, step = cached[1], cached[2]
+                else:
+                    metric, step = None, None
         with self.lock:
             logs = self._log_buffer
             self._log_buffer = []
-        return {"metric": metric, "step": step, "logs": logs}
+        # trial_id is the one the (metric, step) pair belongs to — callers
+        # must ship THIS id, not re-read reporter.trial_id (which may have
+        # rolled over to the next trial mid-call).
+        return {"metric": metric, "step": step, "logs": logs, "trial_id": tid}
 
     def early_stop(self) -> None:
         """Arm the stop flag (only once a metric exists, reference
@@ -145,3 +174,4 @@ class Reporter:
             self._log_buffer = []
             self.trial_id = trial_id
             self._metric_cache = None
+            self._async_kick = None

@@ -692,7 +692,8 @@ class Client:
                     data = {"metric": None, "step": None, "logs": []}
                 try:
                     resp = self._request(
-                        {"type": "METRIC", "trial_id": reporter.trial_id,
+                        {"type": "METRIC",
+                         "trial_id": data.get("trial_id", reporter.trial_id),
                          "value": data["metric"], "step": data["step"],
                          "logs": data["logs"]},
                         sock=self._hb_sock, lock=False,

@@ -14,6 +14,13 @@ the `tensorboard` package's own `EventFileWriter` + HParams-plugin protos:
 - `write_experiment_config` -> the experiment-level `hparams_config` record
   mapping the Searchspace to HParam domains (dashboard column setup).
 
+The HParams records are assembled directly from the plugin's proto modules
+(`api_pb2`/`plugin_data_pb2`/`metadata`) rather than through
+`tensorboard.plugins.hparams.{api,summary}`: those helper modules import
+full TensorFlow (~5 s), which would land on the experiment-startup critical
+path the first time a searchspace config or trial hparams record is
+written. The proto modules load in ~0.2 s with no TF.
+
 Falls back to JSON artifacts when the `tensorboard` package is absent.
 `jax.profiler` trace capture is the idiomatic TPU addition (SURVEY.md §5.1);
 traces land in the trial logdir and open in TB's profile plugin.
@@ -23,11 +30,19 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, Optional
 
-_logdir: Optional[str] = None
-_writer = None
+# Per-THREAD registry: trial runners are threads sharing this module (the
+# reference's executors are separate processes, `trial_executor.py:122`, so
+# its module-global logdir is per-trial for free — here a module global
+# would let concurrent trials close/steal each other's writers).
+_state = threading.local()
+
+
+def _get(name: str):
+    return getattr(_state, name, None)
 
 
 def _clean_hparams(hparams: Dict[str, Any]) -> Dict[str, Any]:
@@ -35,13 +50,67 @@ def _clean_hparams(hparams: Dict[str, Any]) -> Dict[str, Any]:
             for k, v in hparams.items()}
 
 
+def _hp_record(tag: str, plugin_data):
+    """Summary carrying one HParamsPluginData record (compat-flavored, so
+    it feeds EventFileWriter without re-parsing)."""
+    from tensorboard.compat.proto.summary_pb2 import Summary
+    from tensorboard.plugins.hparams import metadata as hp_meta
+
+    s = Summary()
+    v = s.value.add(tag=tag, metadata=hp_meta.create_summary_metadata(plugin_data))
+    v.tensor.CopyFrom(hp_meta.NULL_TENSOR)
+    return s
+
+
+def _session_start_summary(hparams: Dict[str, Any]):
+    from tensorboard.plugins.hparams import metadata as hp_meta
+    from tensorboard.plugins.hparams import plugin_data_pb2
+
+    info = plugin_data_pb2.SessionStartInfo(start_time_secs=time.time())
+    for name, val in hparams.items():
+        if isinstance(val, bool):  # before int: bool is an int subtype
+            info.hparams[name].bool_value = val
+        elif isinstance(val, (int, float)):
+            info.hparams[name].number_value = val
+        else:
+            info.hparams[name].string_value = str(val)
+    return _hp_record(
+        hp_meta.SESSION_START_INFO_TAG,
+        plugin_data_pb2.HParamsPluginData(session_start_info=info))
+
+
+def _session_end_summary():
+    from tensorboard.plugins.hparams import api_pb2
+    from tensorboard.plugins.hparams import metadata as hp_meta
+    from tensorboard.plugins.hparams import plugin_data_pb2
+
+    info = plugin_data_pb2.SessionEndInfo(
+        status=api_pb2.STATUS_SUCCESS, end_time_secs=time.time())
+    return _hp_record(
+        hp_meta.SESSION_END_INFO_TAG,
+        plugin_data_pb2.HParamsPluginData(session_end_info=info))
+
+
+def _force_tb_stub() -> None:
+    """Point tensorboard.compat's lazy `tf` at the bundled stub unless real
+    TensorFlow is already loaded. EventFileWriter only needs `tf.io.gfile`;
+    without this, its first use triggers `import tensorflow` (~5 s) on the
+    experiment-startup critical path. Installing the `tensorboard.compat.notf`
+    marker module is the package's documented way to force the stub."""
+    import sys
+    import types
+
+    if "tensorflow" not in sys.modules:
+        sys.modules.setdefault(
+            "tensorboard.compat.notf", types.ModuleType("tensorboard.compat.notf"))
+
+
 class _EventWriter:
     """Thin wrapper over tensorboard's EventFileWriter with the HParams
-    plugin records. Proto note: when tensorflow is installed the hparams
-    helpers return TF-flavored protos while EventFileWriter wants
-    tensorboard.compat protos — they are wire-identical, so we re-parse."""
+    plugin records (built proto-level — see module docstring)."""
 
     def __init__(self, logdir: str):
+        _force_tb_stub()
         from tensorboard.summary.writer.event_file_writer import EventFileWriter
 
         self._writer = EventFileWriter(logdir)
@@ -50,13 +119,6 @@ class _EventWriter:
         from tensorboard.compat.proto.event_pb2 import Event
 
         return Event(wall_time=time.time(), **kwargs)
-
-    def _compat(self, summary):
-        from tensorboard.compat.proto.summary_pb2 import Summary
-
-        if isinstance(summary, Summary):
-            return summary
-        return Summary.FromString(summary.SerializeToString())
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:
         from tensorboard.compat.proto.summary_pb2 import Summary
@@ -67,22 +129,17 @@ class _EventWriter:
 
     def write_hparams(self, hparams: Dict[str, Any],
                       metrics: Optional[Dict[str, float]]) -> None:
-        from tensorboard.plugins.hparams import summary as hp_summary
-
-        start = hp_summary.session_start_pb(_clean_hparams(hparams))
-        self._writer.add_event(self._event(summary=self._compat(start)))
+        start = _session_start_summary(_clean_hparams(hparams))
+        self._writer.add_event(self._event(summary=start))
         for tag, value in (metrics or {}).items():
             self.add_scalar(tag, value, 0)
 
     def write_experiment(self, summary_pb) -> None:
-        self._writer.add_event(self._event(summary=self._compat(summary_pb)))
+        self._writer.add_event(self._event(summary=summary_pb))
 
     def close(self) -> None:
-        from tensorboard.plugins.hparams import summary as hp_summary
-
         try:
-            end = hp_summary.session_end_pb("STATUS_SUCCESS")
-            self._writer.add_event(self._event(summary=self._compat(end)))
+            self._writer.add_event(self._event(summary=_session_end_summary()))
         except Exception:  # noqa: BLE001 - close must always flush
             pass
         self._writer.flush()
@@ -97,69 +154,92 @@ def _make_writer(logdir: str):
 
 
 def _register(trial_logdir: str) -> None:
-    """Called by the trial executor when a trial starts."""
-    global _logdir, _writer
+    """Called by the trial executor (in the runner's thread) when a trial
+    starts; closes this thread's previous trial writer."""
     _close()
     os.makedirs(trial_logdir, exist_ok=True)
-    _logdir = trial_logdir
-    _writer = _make_writer(trial_logdir)
+    _state.logdir = trial_logdir
+    _state.writer = _make_writer(trial_logdir)
 
 
 def _close() -> None:
-    global _writer, _logdir
-    if _writer is not None:
+    writer = _get("writer")
+    if writer is not None:
         try:
-            _writer.close()
+            writer.close()
         except Exception:  # noqa: BLE001
             pass
-    _writer = None
-    _logdir = None
+    _state.writer = None
+    _state.logdir = None
 
 
 def logdir() -> str:
     """The current trial's TensorBoard logdir (reference `tensorboard.py:33`)."""
-    if _logdir is None:
+    current = _get("logdir")
+    if current is None:
         raise RuntimeError("No trial logdir registered; are you inside a trial?")
-    return _logdir
+    return current
 
 
 def add_scalar(tag: str, value: float, step: int = 0) -> None:
-    if _writer is not None:
-        _writer.add_scalar(tag, value, step)
-    elif _logdir is not None:
-        with open(os.path.join(_logdir, "scalars.jsonl"), "a") as f:
+    writer, current = _get("writer"), _get("logdir")
+    if writer is not None:
+        writer.add_scalar(tag, value, step)
+    elif current is not None:
+        with open(os.path.join(current, "scalars.jsonl"), "a") as f:
             f.write(json.dumps({"tag": tag, "value": float(value), "step": step}) + "\n")
 
 
 def write_hparams(hparams: Dict[str, Any], metrics: Optional[Dict[str, float]] = None) -> None:
     """Per-trial hparams record (reference `tensorboard.py:90-93`)."""
-    if _logdir is None:
+    writer, current = _get("writer"), _get("logdir")
+    if current is None:
         return
-    if _writer is not None:
-        _writer.write_hparams(hparams, metrics)
+    if writer is not None:
+        writer.write_hparams(hparams, metrics)
     else:
-        with open(os.path.join(_logdir, "hparams.json"), "w") as f:
+        with open(os.path.join(current, "hparams.json"), "w") as f:
             json.dump(hparams, f, default=str)
 
 
 def _experiment_pb(searchspace):
     """Searchspace -> HParams-plugin experiment config proto (the dashboard
-    column setup; reference `tensorboard.py:75-87`)."""
-    from tensorboard.plugins.hparams import api as hp
-    from tensorboard.plugins.hparams import summary_v2 as hp_v2
+    column setup; reference `tensorboard.py:75-87`). Built proto-level: the
+    `hparams.api` helper module imports full TensorFlow."""
+    from google.protobuf import struct_pb2
+    from tensorboard.plugins.hparams import api_pb2
+    from tensorboard.plugins.hparams import metadata as hp_meta
+    from tensorboard.plugins.hparams import plugin_data_pb2
 
-    hparams = []
+    infos = []
     for name, spec in searchspace.to_dict().items():
         hp_type, region = spec["type"], spec["values"]
-        if hp_type == "DOUBLE":
-            dom = hp.RealInterval(float(region[0]), float(region[1]))
-        elif hp_type == "INTEGER":
-            dom = hp.IntInterval(int(region[0]), int(region[1]))
+        if hp_type in ("DOUBLE", "INTEGER"):
+            infos.append(api_pb2.HParamInfo(
+                name=name, type=api_pb2.DATA_TYPE_FLOAT64,
+                domain_interval=api_pb2.Interval(
+                    min_value=float(region[0]), max_value=float(region[1]))))
         else:  # DISCRETE / CATEGORICAL
-            dom = hp.Discrete(list(region))
-        hparams.append(hp.HParam(name, dom))
-    return hp_v2.hparams_config_pb(
-        hparams=hparams, metrics=[hp.Metric("metric")])
+            numeric = all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                          for v in region)
+            domain = struct_pb2.ListValue()
+            for v in region:
+                if numeric:
+                    domain.values.add().number_value = float(v)
+                else:
+                    domain.values.add().string_value = str(v)
+            infos.append(api_pb2.HParamInfo(
+                name=name,
+                type=(api_pb2.DATA_TYPE_FLOAT64 if numeric
+                      else api_pb2.DATA_TYPE_STRING),
+                domain_discrete=domain))
+    experiment = api_pb2.Experiment(
+        time_created_secs=time.time(), hparam_infos=infos,
+        metric_infos=[api_pb2.MetricInfo(
+            name=api_pb2.MetricName(tag="metric"))])
+    return _hp_record(
+        hp_meta.EXPERIMENT_TAG,
+        plugin_data_pb2.HParamsPluginData(experiment=experiment))
 
 
 def write_experiment_config(exp_dir: str, searchspace) -> None:

@@ -9,6 +9,7 @@ import socket
 import threading
 import time
 
+import numpy as np
 import pytest
 
 from maggy_tpu.core.reporter import Reporter
@@ -323,6 +324,56 @@ class TestLazyMetrics:
         else:
             pytest.fail("lazy metric never reached the driver")
         client.stop()
+
+    class _FakeDeviceScalar:
+        """Stand-in for a jax.Array scalar with a controllable readiness."""
+        shape = ()
+        dtype = np.float32
+
+        def __init__(self, value, ready):
+            self.value, self.ready, self.kicks = value, ready, 0
+
+        def is_ready(self):
+            return self.ready
+
+        def copy_to_host_async(self):
+            self.kicks += 1
+
+        def __float__(self):
+            assert self.ready, "heartbeat blocked on an un-ready device value"
+            return self.value
+
+    def test_unready_value_ships_previous_pair_without_blocking(self):
+        rep = Reporter()
+        rep.reset(trial_id="t")
+        first = self._FakeDeviceScalar(1.0, ready=True)
+        rep.broadcast(first, step=0)
+        assert rep.get_data()["metric"] == pytest.approx(1.0)
+
+        pending = self._FakeDeviceScalar(2.0, ready=False)
+        rep.broadcast(pending, step=1)
+        data = rep.get_data()
+        # The in-flight value is NOT awaited: the previous materialized
+        # (metric, step) pair ships instead, and one async copy is kicked.
+        assert data["metric"] == pytest.approx(1.0)
+        assert data["step"] == 0
+        assert pending.kicks == 1
+        rep.get_data()
+        assert pending.kicks == 1  # kicked once, not per beat
+
+        pending.ready = True
+        data = rep.get_data()
+        assert data["metric"] == pytest.approx(2.0)
+        assert data["step"] == 1
+
+    def test_unready_first_value_ships_empty_beat(self):
+        rep = Reporter()
+        rep.reset(trial_id="t")
+        pending = self._FakeDeviceScalar(3.0, ready=False)
+        rep.broadcast(pending, step=0)
+        data = rep.get_data()
+        assert data["metric"] is None
+        assert data["step"] is None
 
     def test_multi_element_arrays_rejected(self):
         import jax.numpy as jnp

@@ -104,6 +104,41 @@ class TestEventFiles:
         with pytest.raises(RuntimeError, match="logdir"):
             tb.logdir()
 
+    def test_concurrent_runner_threads_are_isolated(self, tmp_path):
+        """Trial runners are THREADS sharing this module: one runner's
+        `_register` must not close or steal another's in-flight writer
+        (regression: module-global state sent thread A's scalars to thread
+        B's event file and left A's session without an end record)."""
+        import threading
+
+        barrier = threading.Barrier(2)
+        errors = []
+
+        def runner(name):
+            try:
+                logdir = str(tmp_path / name)
+                tb._register(logdir)
+                barrier.wait(timeout=10)  # both writers now open
+                assert tb.logdir() == logdir
+                tb.add_scalar("m", float(len(name)), 0)
+                barrier.wait(timeout=10)
+                tb._close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=runner, args=(n,))
+                   for n in ("aa", "bbb")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        for name in ("aa", "bbb"):
+            tags, scalars = _load_tags(str(tmp_path / name))
+            # Own scalar, own end record — nothing leaked across threads.
+            assert scalars[("m", 0)] == pytest.approx(float(len(name)))
+            assert "_hparams_/session_end_info" in tags
+
 
 class TestTrialExecutorIntegration:
     def test_every_trial_dir_gets_an_event_file(self, tmp_path):
