@@ -529,12 +529,21 @@ def main():
         log("hand-off gap ms: median {} p95 {} (n={})".format(
             handoff["median_ms"], handoff["p95_ms"], handoff["n"]))
 
-    sha_wall = run_sync_sha_baseline(rung_schedule)
+    # Two interleaved runs per baseline, keeping each baseline's MIN wall:
+    # sustained-load drift (host thermal/noisy-neighbor — measured +12%
+    # across back-to-back identical runs on the CPU proxy) would otherwise
+    # penalize whichever baseline happens to run last. The min leans
+    # conservative: sync-SHA (the primary comparator) gets the earliest,
+    # coolest slot.
+    oracle_sched = [args[2:] for args in schedule]
+    sha_wall = oracle_wall = float("inf")
+    for _ in range(2):
+        sha_wall = min(sha_wall, run_sync_sha_baseline(rung_schedule))
+        oracle_wall = min(oracle_wall, run_packed_baseline(oracle_sched))
     sha_trials_per_hour = len(schedule) / sha_wall * 3600
-    log("sync-SHA baseline (rung barriers): {} trials in {:.1f}s".format(
+    log("sync-SHA baseline (rung barriers, min of 2): {} trials in {:.1f}s".format(
         len(schedule), sha_wall))
-    oracle_wall = run_packed_baseline([args[2:] for args in schedule])
-    log("oracle replay (packed, no barriers): {} trials in {:.1f}s".format(
+    log("oracle replay (packed, no barriers, min of 2): {} trials in {:.1f}s".format(
         len(schedule), oracle_wall))
 
     extras = run_extra_benches()

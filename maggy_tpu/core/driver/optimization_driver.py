@@ -208,7 +208,15 @@ class OptimizationDriver(Driver):
             path = "{}/{}/trial.json".format(self.exp_dir, name)
             if not self.env.exists(path):
                 continue
-            trial = Trial.from_json(self.env.load(path))
+            try:
+                trial = Trial.from_json(self.env.load(path))
+            except (ValueError, KeyError):
+                # Torn artifact from a hard kill mid-write (pre-atomic-dump
+                # experiments): the trial was in flight, so treating it as
+                # unfinished and re-running it is exactly resume semantics.
+                self._log("resume: skipping unreadable {} (trial will "
+                          "re-run)".format(path))
+                continue
             if trial.status == Trial.FINALIZED and trial.final_metric is not None:
                 restored.append(trial)
         with self._store_lock:

@@ -106,9 +106,16 @@ class LocalEnv(AbstractEnv):
         os.makedirs(path, exist_ok=True)
 
     def dump(self, data: str, path: str) -> None:
+        # Atomic (tmp + rename): artifacts like trial.json and the pruner
+        # bracket state are read back by `resume=True` — a hard kill
+        # mid-write must leave old-or-nothing, never a torn file.
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w") as f:
+        import threading
+
+        tmp = "{}.tmp.{}.{}".format(path, os.getpid(), threading.get_ident())
+        with open(tmp, "w") as f:
             f.write(data)
+        os.replace(tmp, path)
 
     def load(self, path: str) -> str:
         with open(path) as f:

@@ -159,10 +159,14 @@ class Reporter:
         # rolled over to the next trial mid-call).
         return {"metric": metric, "step": step, "logs": logs, "trial_id": tid}
 
-    def early_stop(self) -> None:
+    def early_stop(self, trial_id: Optional[str] = None) -> None:
         """Arm the stop flag (only once a metric exists, reference
-        `reporter.py:158-161`)."""
+        `reporter.py:158-161`). ``trial_id``, when given, must match the
+        current trial: a STOP reply to a heartbeat that shipped the
+        PREVIOUS trial's data must not stop the trial that replaced it."""
         with self.lock:
+            if trial_id is not None and trial_id != self.trial_id:
+                return
             if self.metric is not None:
                 self._stop_flag = True
 

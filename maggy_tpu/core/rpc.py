@@ -690,16 +690,19 @@ class Client:
                     except Exception:  # noqa: BLE001
                         pass
                     data = {"metric": None, "step": None, "logs": []}
+                sent_tid = data.get("trial_id", reporter.trial_id)
                 try:
                     resp = self._request(
-                        {"type": "METRIC",
-                         "trial_id": data.get("trial_id", reporter.trial_id),
+                        {"type": "METRIC", "trial_id": sent_tid,
                          "value": data["metric"], "step": data["step"],
                          "logs": data["logs"]},
                         sock=self._hb_sock, lock=False,
                     )
                     if resp.get("type") == "STOP":
-                        reporter.early_stop()
+                        # Only stop the trial the beat was ABOUT: the
+                        # runner may have rolled over to the next trial
+                        # while this beat was in flight.
+                        reporter.early_stop(trial_id=sent_tid)
                 except ConnectionError:
                     pass
                 self._hb_stop.wait(self.hb_interval)

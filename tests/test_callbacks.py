@@ -25,7 +25,8 @@ class TestNativeCallbacks:
         rep.reset(trial_id="t")
         cb = EpochEnd(rep, metric="acc")
         cb({"acc": 0.8}, step=3)
-        assert rep.get_data() == {"metric": 0.8, "step": 3, "logs": []}
+        assert rep.get_data() == {"metric": 0.8, "step": 3, "logs": [],
+                                  "trial_id": "t"}
 
     def test_missing_metric_is_skipped(self):
         rep = Reporter()

@@ -165,6 +165,42 @@ class TestEarlyStopE2E:
         assert result["early_stopped"] >= 1
 
 
+class TestStartupLatency:
+    def test_no_heavy_imports_on_experiment_path(self, tmp_path):
+        """A plain sweep must not drag TensorFlow or sklearn into the
+        process: both sat on the lagom critical path once (TF via the
+        HParams helper modules ~5 s, sklearn via the eager gp/tpe registry
+        ~2.5 s) and turned experiment startup into 7.4 s of imports
+        (BASELINE.md round-3 profile). tensorboard's writer must run on
+        its bundled TF stub. Subprocess: in-process sys.modules is
+        polluted by whichever tests ran earlier."""
+        import subprocess
+        import sys
+
+        script = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MAGGY_TPU_BASE_DIR"] = {base!r}
+from maggy_tpu import OptimizationConfig, Searchspace, experiment
+
+config = OptimizationConfig(
+    name="startup", num_trials=2, optimizer="randomsearch",
+    searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2])),
+    direction="max", num_workers=1, es_policy="none", seed=0)
+result = experiment.lagom(lambda lr: {{"metric": lr}}, config)
+assert result["num_trials"] == 2, result
+assert "tensorflow" not in sys.modules, "TF on the experiment path"
+assert "sklearn" not in sys.modules, "sklearn on the experiment path"
+print("STARTUP_CLEAN")
+""".format(base=str(tmp_path / "exp"))
+        out = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True, text=True,
+            timeout=180,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "STARTUP_CLEAN" in out.stdout
+
+
 class TestGuards:
     def test_unknown_config_type(self):
         with pytest.raises(TypeError, match="Unsupported config"):

@@ -71,6 +71,33 @@ class TestResumeE2E:
         # Both runs share one experiment directory (run id reused).
         assert len(os.listdir(exp_base)) == 1
 
+    def test_resume_tolerates_torn_trial_json(self, tmp_path, monkeypatch):
+        """A hard kill mid-write can leave an unparseable trial.json (from
+        runs predating atomic dumps): resume must treat that trial as
+        unfinished and re-run it, not crash (regression: JSONDecodeError
+        aborted the resumed run)."""
+        import glob
+
+        count_dir = tmp_path / "counts"
+        count_dir.mkdir()
+        monkeypatch.setenv("MAGGY_TEST_COUNT_DIR", str(count_dir))
+        exp_base = str(tmp_path / "exp")
+
+        r1 = experiment.lagom(train_counting,
+                              cfg(num_trials=3, experiment_dir=exp_base))
+        assert r1["num_trials"] == 3
+        # Tear one artifact the way a mid-write SIGKILL would.
+        victim = sorted(glob.glob(
+            os.path.join(exp_base, "*", "*", "trial.json")))[0]
+        with open(victim, "w") as f:
+            f.write('{"id": "tru')
+
+        r2 = experiment.lagom(train_counting,
+                              cfg(num_trials=3, experiment_dir=exp_base,
+                                  resume=True))
+        # 2 restored + the torn one re-executed.
+        assert r2["num_trials"] == 3
+
     def test_resume_without_prior_run_raises(self, tmp_path):
         with pytest.raises(ValueError, match="no previous run"):
             experiment.lagom(train_counting,

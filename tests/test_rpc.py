@@ -366,6 +366,24 @@ class TestLazyMetrics:
         assert data["metric"] == pytest.approx(2.0)
         assert data["step"] == 1
 
+    def test_stale_stop_reply_does_not_arm_next_trial(self):
+        """A STOP reply addressed to the PREVIOUS trial's id must not stop
+        the trial that replaced it mid-flight (regression: the heartbeat
+        armed the reporter unconditionally)."""
+        rep = Reporter()
+        rep.reset(trial_id="trial-A")
+        rep.broadcast(0.5, step=0)
+        # Trial A finalizes; trial B starts and reports.
+        rep.reset(trial_id="trial-B")
+        rep.broadcast(0.7, step=0)
+        # Late STOP for A arrives: must be ignored...
+        rep.early_stop(trial_id="trial-A")
+        rep.broadcast(0.8, step=1)  # would raise if armed
+        # ...while a STOP for the live trial still works.
+        rep.early_stop(trial_id="trial-B")
+        with pytest.raises(EarlyStopException):
+            rep.broadcast(0.9, step=2)
+
     def test_unready_first_value_ships_empty_beat(self):
         rep = Reporter()
         rep.reset(trial_id="t")
