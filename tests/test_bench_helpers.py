@@ -84,3 +84,60 @@ class TestChipPeak:
         monkeypatch.setattr(jax, "devices", lambda: [FakeDev()])
         kind, peak = bench.chip_peak_flops()
         assert kind == "TPU v99 mega" and peak == 197e12
+
+
+class TestStageBaselines:
+    """The baselines' scheduling mechanics, with train_mnist stubbed out."""
+
+    def _record_runs(self, monkeypatch):
+        import threading
+
+        runs, active, peak = [], [0], [0]
+        lock = threading.Lock()
+
+        def fake_train(lr, batch=256, budget=1, reporter=None):
+            with lock:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            try:
+                import time
+
+                time.sleep(0.02 * budget)
+                with lock:
+                    runs.append((lr, batch, budget))
+            finally:
+                with lock:
+                    active[0] -= 1
+
+        monkeypatch.setattr(bench, "train_mnist", fake_train)
+        return runs, peak
+
+    def test_packed_runs_everything_with_bounded_concurrency(self, monkeypatch):
+        runs, peak = self._record_runs(monkeypatch)
+        sched = [(0.1 * i, 128, 1 + (i % 3)) for i in range(10)]
+        bench.run_packed_baseline(sched, workers=3)
+        assert sorted(runs) == sorted(sched)
+        # Actually packed: overlap happened (sleepy trials + 3 workers),
+        # but never more than the worker count.
+        assert 2 <= peak[0] <= 3
+
+    def test_packed_propagates_trial_failure(self, monkeypatch):
+        def boom(lr, batch=256, budget=1, reporter=None):
+            raise RuntimeError("trial exploded")
+
+        monkeypatch.setattr(bench, "train_mnist", boom)
+        with pytest.raises(RuntimeError, match="exploded"):
+            bench.run_packed_baseline([(0.1, 128, 1)], workers=2)
+
+    def test_sync_sha_orders_rungs_with_barriers(self, monkeypatch):
+        runs, _ = self._record_runs(monkeypatch)
+        rungs = {0: [(0.1, 128, 1), (0.2, 256, 1), (0.3, 512, 1)],
+                 1: [(0.1, 128, 3)],
+                 2: [(0.1, 128, 9)]}
+        bench.run_sync_sha_baseline(rungs, workers=2)
+        budgets = [b for (_, _, b) in runs]
+        # Barrier between rungs: every rung-0 run completes before the
+        # rung-1 run starts, which completes before rung 2.
+        assert budgets.index(3) >= 3
+        assert budgets.index(9) == len(budgets) - 1
+        assert len(runs) == 5
