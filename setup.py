@@ -23,7 +23,7 @@ setup(
     ],
     extras_require={
         "checkpoint": ["orbax-checkpoint"],
-        "tensorboard": ["torch", "tensorboard"],
+        "tensorboard": ["tensorboard"],  # torch-free: proto-level writer
         "gcs": ["gcsfs"],
     },
     entry_points={

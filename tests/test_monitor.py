@@ -1,0 +1,74 @@
+"""Monitor CLI: the LOG-polling watcher (reference streams progress via
+sparkmagic polling the LOG RPC, `rpc.py:369-377` — ours is a standalone
+CLI usable from any host that can reach the driver)."""
+
+import pytest
+
+from maggy_tpu import monitor
+from maggy_tpu.core.rpc import OptimizationServer
+
+
+class SnapshotDriver:
+    def __init__(self, snap):
+        self._snap = snap
+
+    def enqueue(self, msg):
+        pass
+
+    def get_trial(self, trial_id):
+        return None
+
+    def progress_snapshot(self):
+        return dict(self._snap)
+
+
+@pytest.fixture
+def live_server():
+    driver = SnapshotDriver(
+        {"num_trials": 10, "finalized": 4, "best_val": 0.925, "early_stopped": 1})
+    server = OptimizationServer(num_executors=1)
+    server.attach_driver(driver)
+    addr = server.start()
+    yield server, driver, addr
+    server.stop()
+
+
+class TestPollAndRender:
+    def test_poll_progress_round_trip(self, live_server):
+        server, driver, addr = live_server
+        snap = monitor.poll_progress(addr, server.secret_hex)
+        assert snap["finalized"] == 4
+        assert snap["best_val"] == pytest.approx(0.925)
+
+    def test_render_hpo_snapshot(self):
+        line = monitor.render({"num_trials": 10, "finalized": 4,
+                               "best_val": 0.925, "early_stopped": 1})
+        assert "4/10" in line
+        assert "best=0.925" in line
+        assert "early_stopped=1" in line
+
+    def test_render_distributed_snapshot(self):
+        line = monitor.render({"num_workers": 8, "workers_done": 3})
+        assert "3/8" in line and "workers done" in line
+
+
+class TestCli:
+    def test_once_against_live_driver(self, live_server, capsys):
+        server, driver, addr = live_server
+        rc = monitor.main(["--driver", "{}:{}".format(*addr),
+                           "--secret", server.secret_hex, "--once"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "4/10" in out
+
+    def test_unreachable_driver_fails_fast(self, capsys):
+        rc = monitor.main(["--driver", "127.0.0.1:1",  # nothing listens there
+                           "--secret", "00", "--once"])
+        assert rc == 1
+        assert "cannot reach driver" in capsys.readouterr().err
+
+    def test_wrong_secret_is_an_error_not_a_hang(self, live_server):
+        server, driver, addr = live_server
+        rc = monitor.main(["--driver", "{}:{}".format(*addr),
+                           "--secret", "deadbeef", "--once"])
+        assert rc == 1
