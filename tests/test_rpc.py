@@ -393,6 +393,50 @@ class TestLazyMetrics:
         assert data["metric"] is None
         assert data["step"] is None
 
+    def test_rollover_stress_no_cross_trial_leakage(self):
+        """Hammer broadcast/get_data/reset from concurrent threads (the
+        real heartbeat-vs-trial-loop shape) and assert a beat NEVER pairs
+        one trial's metric with another trial's id — the two races fixed
+        in round 3 (late cache write; stale STOP) were both of this
+        family. Metrics are trial-coded (trial k broadcasts values in
+        [1000k, 1000k+999]) so leakage is detectable from the outside."""
+        rep = Reporter()
+        stop = threading.Event()
+        bad, reader_errors = [], []
+        observed = [0]
+
+        def beats():
+            while not stop.is_set():
+                try:
+                    data = rep.get_data()
+                except Exception as e:  # noqa: BLE001 - surface after join
+                    reader_errors.append(e)
+                    return
+                m, tid = data["metric"], data["trial_id"]
+                if m is not None and tid is not None:
+                    observed[0] += 1
+                    if not (1000 * int(tid) <= m < 1000 * (int(tid) + 1)):
+                        bad.append((tid, m))
+
+        hb = threading.Thread(target=beats)
+        hb.start()
+        try:
+            for k in range(50):
+                rep.reset(trial_id=str(k))
+                for step in range(20):
+                    val = self._FakeDeviceScalar(
+                        1000.0 * k + step, ready=(step % 3 != 0))
+                    rep.broadcast(val, step=step)
+                    if step % 7 == 0:
+                        val.ready = True
+        finally:
+            stop.set()
+            hb.join(timeout=10)
+        assert not reader_errors, reader_errors
+        assert not bad, "cross-trial metric leakage: {}".format(bad[:5])
+        # Vacuity guard: the reader actually sampled (metric, id) pairs.
+        assert observed[0] > 0
+
     def test_multi_element_arrays_rejected(self):
         import jax.numpy as jnp
 
