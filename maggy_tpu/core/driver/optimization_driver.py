@@ -22,7 +22,7 @@ from maggy_tpu.core.executors.trial_executor import trial_executor_fn
 from maggy_tpu.core.rpc import OptimizationServer
 from maggy_tpu.core.runner_pool import ThreadRunnerPool
 from maggy_tpu.earlystop import MedianStoppingRule, NoStoppingRule
-from maggy_tpu.optimizers import Asha, GridSearch, RandomSearch, SingleRun
+from maggy_tpu.optimizers import PBT, Asha, GridSearch, RandomSearch, SingleRun
 from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
 from maggy_tpu.trial import Trial
 
@@ -45,6 +45,7 @@ CONTROLLER_REGISTRY = {
     "randomsearch": RandomSearch,
     "gridsearch": GridSearch,
     "asha": Asha,
+    "pbt": PBT,
     "tpe": _lazy_tpe,
     "gp": _lazy_gp,
     "none": SingleRun,
@@ -129,11 +130,16 @@ class OptimizationDriver(Driver):
 
     def _resolve_num_trials(self, config) -> int:
         # Pruner owns the schedule; gridsearch computes from the space
-        # (reference `optimization_driver.py:63-69`).
+        # (reference `optimization_driver.py:63-69`); controllers with a
+        # fixed combinatorial schedule (PBT: population x generations)
+        # expose it via schedule_size().
         if self.controller.pruner is not None:
             return self.controller.pruner.num_trials()
         if isinstance(self.controller, GridSearch):
             return GridSearch.get_num_trials(config.searchspace)
+        size = getattr(self.controller, "schedule_size", None)
+        if size is not None:
+            return size()
         return config.num_trials
 
     @staticmethod
@@ -445,7 +451,20 @@ class OptimizationDriver(Driver):
             self._rearm_idle(partition_id)
         elif suggestion is not None:
             with self._store_lock:
+                # Trial ids hash the params; a controller emitting two
+                # distinct units of work with identical params silently
+                # collapses them here (one store slot) and loses a
+                # schedule entry — exactly how a PBT id-collision bug
+                # dropped 2 of 9 segments. Make it loud.
+                duplicate = (suggestion.trial_id in self._trial_store
+                             or any(t.trial_id == suggestion.trial_id
+                                    for t in self._final_store))
                 self._trial_store[suggestion.trial_id] = suggestion
+            if duplicate:
+                self._log("WARNING: controller re-issued trial id {} "
+                          "(params hash-collide with an in-flight or "
+                          "finalized trial); the schedule may lose an "
+                          "entry".format(suggestion.trial_id))
             # The controller just mutated its schedule (Hyperband bound the
             # new run to a bracket slot) — persist so resume=True can pick
             # the bracket up mid-flight.

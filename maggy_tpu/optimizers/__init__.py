@@ -5,5 +5,6 @@ from maggy_tpu.optimizers.randomsearch import RandomSearch
 from maggy_tpu.optimizers.gridsearch import GridSearch
 from maggy_tpu.optimizers.singlerun import SingleRun
 from maggy_tpu.optimizers.asha import Asha
+from maggy_tpu.optimizers.pbt import PBT
 
-__all__ = ["AbstractOptimizer", "RandomSearch", "GridSearch", "SingleRun", "Asha"]
+__all__ = ["AbstractOptimizer", "RandomSearch", "GridSearch", "SingleRun", "Asha", "PBT"]

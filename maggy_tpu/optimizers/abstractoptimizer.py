@@ -150,9 +150,14 @@ class AbstractOptimizer(ABC):
             return {trial_ids}
         return set(trial_ids)
 
-    @staticmethod
-    def _strip_budget(params: Dict[str, Any]) -> Dict[str, Any]:
-        return {k: v for k, v in params.items() if k != "budget"}
+    # Scheduler-injected params that are NOT hyperparameters: stripped from
+    # reported best_hp/worst_hp and from duplicate/encoding comparisons.
+    # Subclasses that inject more (PBT: generation/member) extend this.
+    SYNTHETIC_PARAMS = ("budget",)
+
+    def _strip_budget(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        return {k: v for k, v in params.items()
+                if k not in self.SYNTHETIC_PARAMS}
 
     def hparams_exist(self, trial: Trial) -> bool:
         """True if this trial's budget-stripped params match any finalized or

@@ -1,0 +1,254 @@
+"""PBT — asynchronous Population Based Training (arXiv:1711.09846).
+
+Beyond the reference's optimizer set (SURVEY.md §2.5 lists randomsearch /
+gridsearch / asha / singlerun / GP / TPE): PBT trains a population jointly,
+periodically replacing the weakest members with perturbed clones of the
+strongest — weights included. It exists here because this framework already
+has the two ingredients the reference lacks: an async driver that can hand a
+member its next segment the moment the previous one finalizes (no
+generation barrier), and per-trial orbax checkpoints with parent warm-start
+(`TrialContext.restore_parent`) so "clone the winner's weights" is the same
+mechanism ASHA promotions use.
+
+Scheduling model: each population member runs ``generations`` consecutive
+trials ("segments") of ``resource_per_generation`` budget each. When member
+m's generation-g segment finalizes, its g+1 segment is decided IMMEDIATELY
+against the generation-g results seen so far (async PBT, like the paper's
+population-device variant):
+
+- bottom ``exploit_quantile`` of finalized gen-g peers -> EXPLOIT: adopt a
+  top-quantile peer's hparams (perturbed) and set ``parent`` to that peer's
+  segment so the executor warm-starts from its checkpoint;
+- otherwise -> CONTINUE: same hparams, ``parent`` = own previous segment.
+
+The train function sees ``generation``, ``member``, and ``budget`` as
+hparams and is expected to ``ctx.restore_parent(...)``
+(examples/llama_lora_sweep.py shows the pattern for ASHA; PBT uses the
+identical contract).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
+from maggy_tpu.searchspace import Searchspace
+from maggy_tpu.trial import Trial
+
+
+class PBT(AbstractOptimizer):
+    SYNTHETIC_PARAMS = ("budget", "generation", "member")
+
+    def __init__(
+        self,
+        population: int = 8,
+        generations: int = 4,
+        resource_per_generation: float = 1,
+        exploit_quantile: float = 0.25,
+        perturb_factors=(0.8, 1.2),
+        resample_probability: float = 0.25,
+        seed=None,
+    ):
+        super().__init__(seed=seed)
+        if population < 2:
+            raise ValueError("population must be >= 2, got {}".format(population))
+        if generations < 2:
+            raise ValueError("generations must be >= 2, got {}".format(generations))
+        if not 0.0 < exploit_quantile <= 0.5:
+            raise ValueError(
+                "exploit_quantile must be in (0, 0.5], got {}".format(exploit_quantile))
+        self.population = population
+        self.generations = generations
+        self.resource_per_generation = resource_per_generation
+        self.exploit_quantile = exploit_quantile
+        self.perturb_factors = tuple(perturb_factors)
+        self.resample_probability = resample_probability
+        self._pending: List[Trial] = []
+        # member -> consecutive segment errors; a member that errors twice
+        # in a row is retired (dead) so the experiment can still finish.
+        self._errors: Dict[int, int] = {}
+        self._dead: set = set()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def schedule_size(self) -> int:
+        """Total segments = population x generations (the driver-side
+        num_trials; same role as GridSearch.get_num_trials)."""
+        return self.population * self.generations
+
+    def initialize(self) -> None:
+        if not any(self.searchspace.get_type(n) in
+                   (Searchspace.DOUBLE, Searchspace.INTEGER)
+                   for n in self.searchspace.names()):
+            # All-categorical spaces can produce identical perturbed configs
+            # (= identical trial ids within a generation); mirror
+            # RandomSearch's continuous-parameter requirement.
+            raise ValueError(
+                "PBT needs at least one DOUBLE or INTEGER hyperparameter.")
+        for member, params in enumerate(
+                self.searchspace.get_random_parameter_values(
+                    self.population, rng=self.rng)):
+            self._pending.append(self._segment(member, 0, params, parent=None,
+                                               sample_type="random"))
+
+    # ------------------------------------------------------------ scheduling
+
+    def get_suggestion(self, trial: Optional[Trial] = None):
+        if trial is not None:
+            member = trial.info_dict.get("member")
+            if member is not None and trial.final_metric is not None:
+                self._errors.pop(member, None)
+                if trial.info_dict.get("generation", 0) + 1 < self.generations:
+                    self._pending.append(self._next_segment(trial))
+            elif member is not None:
+                self._handle_segment_error(trial, member)
+        if self._pending:
+            return self._pending.pop(0)
+        if self._finished():
+            return None
+        return "IDLE" if self._in_flight() else None
+
+    def _handle_segment_error(self, trial: Trial, member: int) -> None:
+        """A segment ERRORed (train_fn raised). Retry once from the member's
+        last finalized state — or a fresh config if it has none — then
+        retire the member so a deterministically-broken lineage cannot spin
+        the experiment forever. Without this, one errored segment silently
+        ends the whole member (SURVEY.md §5.3's requeue covers runner DEATH,
+        not train-side errors)."""
+        errors = self._errors.get(member, 0) + 1
+        self._errors[member] = errors
+        if errors > 1:
+            self._dead.add(member)
+            return
+        prev = self._population_state().get(member)
+        if prev is not None:
+            self._pending.append(self._next_segment(prev))
+        else:
+            params = self.searchspace.get_random_parameter_values(
+                1, rng=self.rng)[0]
+            self._pending.append(self._segment(member, 0, params, parent=None,
+                                               sample_type="random"))
+
+    def _finished(self) -> bool:
+        done = {t.info_dict.get("member") for t in self.final_store
+                if t.info_dict.get("generation", 0) == self.generations - 1
+                and t.final_metric is not None}
+        return len(done | self._dead) >= self.population
+
+    def _in_flight(self) -> bool:
+        return bool(self.trial_store)
+
+    # -------------------------------------------------------------- segments
+
+    def _segment(self, member: int, generation: int, hparams: dict,
+                 parent: Optional[str], sample_type: str) -> Trial:
+        params = dict(hparams)
+        params["generation"] = generation
+        # member rides in params so segment ids stay unique: trial ids hash
+        # params only, and two members exploiting the same donor with the
+        # same perturb draw produce IDENTICAL hparams — without the member
+        # key their segments collapse into one driver-store entry and a
+        # lineage silently dies (observed: 7 of 9 segments run).
+        params["member"] = member
+        params["budget"] = self.resource_per_generation
+        info = {"sample_type": sample_type, "member": member,
+                "generation": generation}
+        if parent is not None:
+            info["parent"] = parent
+        return Trial(params, info_dict=info)
+
+    def _population_state(self) -> Dict[int, Trial]:
+        """Each member's LATEST finalized segment — the population the
+        paper's exploit step compares against. Comparing only
+        same-generation peers would let the first finisher of every
+        generation escape unchallenged (it has no peers yet) while later
+        finishers compare against a bottom already held by that early
+        weak member; the population view is also what makes the decision
+        sound when members drift generations apart (async)."""
+        latest: Dict[int, Trial] = {}
+        for t in self.final_store:
+            member = t.info_dict.get("member")
+            # final_store also holds ERRORED segments (final_metric None):
+            # they are not population state — using one as a member's
+            # "latest" would skip a generation and point warm-starts at a
+            # checkpoint that may not exist.
+            if member is None or t.final_metric is None:
+                continue
+            if (member not in latest
+                    or t.info_dict.get("generation", 0)
+                    > latest[member].info_dict.get("generation", 0)):
+                latest[member] = t
+        return latest
+
+    def _next_segment(self, finalized: Trial) -> Trial:
+        member = finalized.info_dict["member"]
+        generation = finalized.info_dict.get("generation", 0)
+        metrics = self.get_metrics_dict()  # normalized: lower is better
+        population = self._population_state()
+        population[member] = finalized
+        ranked = sorted((t for t in population.values()
+                         if t.trial_id in metrics),
+                        key=lambda t: metrics[t.trial_id])
+        k = max(1, math.ceil(len(ranked) * self.exploit_quantile))
+        bottom = {t.trial_id for t in ranked[-k:]} if len(ranked) > 1 else set()
+        if finalized.trial_id in bottom:
+            donor = ranked[int(self.rng.integers(0, k))]
+            if donor.info_dict.get("member") != member:
+                return self._segment(
+                    member, generation + 1,
+                    self._perturb(self._hparams_of(donor)),
+                    parent=donor.trial_id, sample_type="exploit")
+        return self._segment(member, generation + 1,
+                             self._hparams_of(finalized),
+                             parent=finalized.trial_id, sample_type="continue")
+
+    def _hparams_of(self, trial: Trial) -> dict:
+        return self._strip_budget(trial.params)
+
+    def _perturb(self, hparams: dict) -> dict:
+        """Explore step: scale continuous params by a perturb factor (clipped
+        to the space), resample discrete/categorical with small probability."""
+        out = {}
+        for name in self.searchspace.names():
+            hp_type = self.searchspace.get_type(name)
+            value = hparams[name]
+            spec = self.searchspace.get(name)
+            if hp_type in (Searchspace.DOUBLE, Searchspace.INTEGER):
+                factor = self.perturb_factors[
+                    int(self.rng.integers(0, len(self.perturb_factors)))]
+                lo, hi = min(spec), max(spec)
+                scaled = min(max(value * factor, lo), hi)
+                out[name] = int(round(scaled)) \
+                    if hp_type == Searchspace.INTEGER else float(scaled)
+            else:
+                if self.rng.random() < self.resample_probability:
+                    out[name] = spec[int(self.rng.integers(0, len(spec)))]
+                else:
+                    out[name] = value
+        return out
+
+    # ---------------------------------------------------------------- resume
+
+    def restore(self, finalized) -> None:
+        """Rebuild the schedule from a previous run; in-flight segments at
+        crash time are re-derived as their parents' successors below."""
+        # Drop initial segments whose member already ran generation 0.
+        done0 = {t.info_dict.get("member") for t in finalized
+                 if t.info_dict.get("generation", 0) == 0}
+        self._pending = [p for p in self._pending
+                         if p.info_dict["member"] not in done0]
+        # Queue next segments for members whose LAST finalized generation
+        # has no successor yet.
+        latest: Dict[int, Trial] = {}
+        for t in finalized:
+            member = t.info_dict.get("member")
+            if member is None:
+                continue
+            generation = t.info_dict.get("generation", 0)
+            if (member not in latest
+                    or generation > latest[member].info_dict.get("generation", 0)):
+                latest[member] = t
+        for t in latest.values():
+            if t.info_dict.get("generation", 0) + 1 < self.generations:
+                self._pending.append(self._next_segment(t))
