@@ -68,7 +68,14 @@ class OptimizationDriver(Driver):
             # interrupted run's experiment.json.
             self._validate_resume()
         self.num_trials = self._resolve_num_trials(config)
-        self.num_executors = min(config.num_workers, self.num_trials)
+        # Controllers whose schedule bounds concurrency below the trial
+        # count (PBT: members are sequential chains, so at most
+        # `population` trials can ever be in flight) cap the worker pool —
+        # excess runners would hold hardware and idle-tick all experiment.
+        max_conc = getattr(self.controller, "max_concurrency", None)
+        ceiling = min(self.num_trials,
+                      max_conc() if max_conc is not None else self.num_trials)
+        self.num_executors = min(config.num_workers, ceiling)
         super().__init__(config, app_id, run_id)
 
         # Trial bookkeeping shared with the server thread.
@@ -456,8 +463,12 @@ class OptimizationDriver(Driver):
                 # collapses them here (one store slot) and loses a
                 # schedule entry — exactly how a PBT id-collision bug
                 # dropped 2 of 9 segments. Make it loud.
+                # ERRORED entries don't count: a controller retrying a
+                # failed unit of work (PBT segment retry) legitimately
+                # re-issues the identical params/id.
                 duplicate = (suggestion.trial_id in self._trial_store
                              or any(t.trial_id == suggestion.trial_id
+                                    and t.final_metric is not None
                                     for t in self._final_store))
                 self._trial_store[suggestion.trial_id] = suggestion
             if duplicate:

@@ -77,15 +77,15 @@ class PBT(AbstractOptimizer):
         num_trials; same role as GridSearch.get_num_trials)."""
         return self.population * self.generations
 
+    def max_concurrency(self) -> int:
+        """Members are sequential segment chains: at most ``population``
+        trials are ever in flight, whatever num_workers says."""
+        return self.population
+
     def initialize(self) -> None:
-        if not any(self.searchspace.get_type(n) in
-                   (Searchspace.DOUBLE, Searchspace.INTEGER)
-                   for n in self.searchspace.names()):
-            # All-categorical spaces can produce identical perturbed configs
-            # (= identical trial ids within a generation); mirror
-            # RandomSearch's continuous-parameter requirement.
-            raise ValueError(
-                "PBT needs at least one DOUBLE or INTEGER hyperparameter.")
+        # All-categorical spaces are fine (explore = resample; the member
+        # key keeps same-hparam segments id-unique), so unlike RandomSearch
+        # there is no continuous-parameter requirement.
         for member, params in enumerate(
                 self.searchspace.get_random_parameter_values(
                     self.population, rng=self.rng)):

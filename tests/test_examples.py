@@ -38,6 +38,8 @@ def run_example(tmp_path, name, *args, timeout=150):
                               "--workers", "2")),
     ("titanic_ablation.py", ()),
     ("distributed_training.py", ()),
+    ("pbt_sweep.py", ("--population", "2", "--generations", "2",
+                      "--workers", "2")),
 ])
 def test_example_runs(tmp_path, name, args):
     run_example(tmp_path, name, *args)

@@ -47,14 +47,23 @@ class TestValidation:
         with pytest.raises(ValueError, match="exploit_quantile"):
             PBT(exploit_quantile=0.8)
 
-    def test_requires_continuous_param(self):
-        sp = Searchspace(act=("CATEGORICAL", ["a", "b"]))
-        opt = PBT(population=2, generations=2, seed=0)
-        with pytest.raises(ValueError, match="DOUBLE or INTEGER"):
-            wire(opt, sp, opt.schedule_size())
+    def test_all_categorical_space_supported(self):
+        """Unlike RandomSearch, PBT works on purely categorical spaces
+        (explore = resample; the member key keeps segment ids unique even
+        when two members hold identical hparams)."""
+        sp = Searchspace(act=("CATEGORICAL", ["a", "b"]),
+                         opt=("DISCRETE", [1, 2, 3]))
+        opt = PBT(population=3, generations=3, seed=0,
+                  resample_probability=0.5)
+        wire(opt, sp, opt.schedule_size())
+        finished = run_pbt(opt, lambda p: float(p["opt"]))
+        assert len(finished) == 9
+        assert len({t.trial_id for t in finished}) == 9
 
-    def test_schedule_size(self):
-        assert PBT(population=6, generations=3).schedule_size() == 18
+    def test_schedule_size_and_concurrency(self):
+        opt = PBT(population=6, generations=3)
+        assert opt.schedule_size() == 18
+        assert opt.max_concurrency() == 6
 
 
 class TestScheduling:
