@@ -50,10 +50,8 @@ class TPE(BaseAsyncBO):
             for name, hp_type in sp._hparam_types.items():
                 region = sp._hparams[name]
                 v = params[name]
-                if hp_type == Searchspace.DOUBLE:
-                    row.append((float(v) - region[0]) / (region[1] - region[0]))
-                elif hp_type == Searchspace.INTEGER:
-                    row.append((float(v) - region[0] + 0.5) / (region[1] - region[0] + 1))
+                if hp_type in Searchspace.CONTINUOUS_TYPES:
+                    row.append(sp.encode_continuous(name, v))
                 else:
                     row.append(float(region.index(v)))
             rows.append(row)
@@ -64,11 +62,8 @@ class TPE(BaseAsyncBO):
         params = {}
         for j, (name, hp_type) in enumerate(sp._hparam_types.items()):
             region = sp._hparams[name]
-            if hp_type == Searchspace.DOUBLE:
-                params[name] = float(region[0] + np.clip(x[j], 0, 1) * (region[1] - region[0]))
-            elif hp_type == Searchspace.INTEGER:
-                n = region[1] - region[0] + 1
-                params[name] = int(min(region[1], region[0] + int(np.clip(x[j], 0, 1) * n)))
+            if hp_type in Searchspace.CONTINUOUS_TYPES:
+                params[name] = sp.decode_continuous(name, x[j])
             else:
                 params[name] = region[int(np.clip(x[j], 0, len(region) - 1))]
         return params

@@ -214,7 +214,7 @@ class PBT(AbstractOptimizer):
             hp_type = self.searchspace.get_type(name)
             value = hparams[name]
             spec = self.searchspace.get(name)
-            if hp_type in (Searchspace.DOUBLE, Searchspace.INTEGER):
+            if hp_type in Searchspace.CONTINUOUS_TYPES:
                 factor = self.perturb_factors[
                     int(self.rng.integers(0, len(self.perturb_factors)))]
                 lo, hi = min(spec), max(spec)

@@ -21,7 +21,7 @@ class RandomSearch(AbstractOptimizer):
 
     def initialize(self) -> None:
         types = set(self.searchspace._hparam_types.values())
-        if not types & {Searchspace.DOUBLE, Searchspace.INTEGER}:
+        if not types & set(Searchspace.CONTINUOUS_TYPES):
             raise ValueError(
                 "RandomSearch requires at least one continuous (DOUBLE/INTEGER) "
                 "parameter; use GridSearch for purely discrete spaces."

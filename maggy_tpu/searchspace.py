@@ -28,6 +28,10 @@ class Searchspace:
     Supported types (reference `searchspace.py:60-63`):
 
     - ``DOUBLE``: continuous, ``(low, high)`` with ``low < high``
+    - ``DOUBLE_LOG``: continuous sampled/encoded log-uniformly, ``(low,
+      high)`` with ``0 < low < high`` — the right prior for scale
+      hyperparameters (learning rate, weight decay); a TPU-build extension
+      beyond the reference's four types (`searchspace.py:60-63`)
     - ``INTEGER``: integer range, ``(low, high)`` inclusive with ``low < high``
     - ``DISCRETE``: explicit list of numeric values
     - ``CATEGORICAL``: explicit list of string values
@@ -39,11 +43,14 @@ class Searchspace:
     """
 
     DOUBLE = "DOUBLE"
+    DOUBLE_LOG = "DOUBLE_LOG"
     INTEGER = "INTEGER"
     DISCRETE = "DISCRETE"
     CATEGORICAL = "CATEGORICAL"
 
-    _TYPES = (DOUBLE, INTEGER, DISCRETE, CATEGORICAL)
+    _TYPES = (DOUBLE, DOUBLE_LOG, INTEGER, DISCRETE, CATEGORICAL)
+    # Continuous kinds (shared by optimizers for guards/perturbations).
+    CONTINUOUS_TYPES = (DOUBLE, DOUBLE_LOG, INTEGER)
 
     def __init__(self, **kwargs):
         self._hparam_types: Dict[str, str] = {}
@@ -85,6 +92,11 @@ class Searchspace:
 
         if hp_type == Searchspace.DOUBLE:
             self._validate_bounds(name, region, (int, float), "DOUBLE")
+        elif hp_type == Searchspace.DOUBLE_LOG:
+            self._validate_bounds(name, region, (int, float), "DOUBLE_LOG")
+            if region[0] <= 0:
+                raise ValueError(
+                    "DOUBLE_LOG bounds of '{}' must be positive, got {!r}.".format(name, region))
         elif hp_type == Searchspace.INTEGER:
             self._validate_bounds(name, region, (int,), "INTEGER")
         elif hp_type == Searchspace.DISCRETE:
@@ -183,6 +195,9 @@ class Searchspace:
                 region = self._hparams[name]
                 if hp_type == Searchspace.DOUBLE:
                     params[name] = float(rng.uniform(region[0], region[1]))
+                elif hp_type == Searchspace.DOUBLE_LOG:
+                    params[name] = float(np.exp(rng.uniform(
+                        np.log(region[0]), np.log(region[1]))))
                 elif hp_type == Searchspace.INTEGER:
                     params[name] = int(rng.integers(region[0], region[1] + 1))
                 else:  # DISCRETE / CATEGORICAL
@@ -197,7 +212,7 @@ class Searchspace:
 
         axes = []
         for name, hp_type in self._hparam_types.items():
-            if hp_type in (Searchspace.DOUBLE, Searchspace.INTEGER):
+            if hp_type in Searchspace.CONTINUOUS_TYPES:
                 raise ValueError(
                     "Grid search requires DISCRETE/CATEGORICAL parameters only; "
                     "'{}' is {}.".format(name, hp_type)
@@ -212,19 +227,43 @@ class Searchspace:
     # encode then normalize by cardinality (reference `searchspace.py:266-443`,
     # vectorized here).
 
+    def encode_continuous(self, name: str, v) -> float:
+        """One continuous value -> [0, 1] (the single source of truth for
+        the per-type scalar codec; TPE's surrogate encoding reuses it)."""
+        hp_type, region = self._hparam_types[name], self._hparams[name]
+        if hp_type == Searchspace.DOUBLE:
+            return (float(v) - region[0]) / (region[1] - region[0])
+        if hp_type == Searchspace.DOUBLE_LOG:
+            lo, hi = np.log(region[0]), np.log(region[1])
+            return float((np.log(float(v)) - lo) / (hi - lo))
+        if hp_type == Searchspace.INTEGER:
+            # map integers to bin centers so inverse rounding is stable
+            return (float(v) - region[0] + 0.5) / (region[1] - region[0] + 1)
+        raise ValueError("'{}' is not a continuous hyperparameter.".format(name))
+
+    def decode_continuous(self, name: str, x: float):
+        """[0, 1] -> a continuous value (inverse of encode_continuous)."""
+        hp_type, region = self._hparam_types[name], self._hparams[name]
+        x = float(np.clip(x, 0.0, 1.0))
+        if hp_type == Searchspace.DOUBLE:
+            return float(region[0] + x * (region[1] - region[0]))
+        if hp_type == Searchspace.DOUBLE_LOG:
+            lo, hi = np.log(region[0]), np.log(region[1])
+            return float(np.exp(lo + x * (hi - lo)))
+        if hp_type == Searchspace.INTEGER:
+            n = region[1] - region[0] + 1
+            return int(min(region[1], region[0] + int(x * n)))
+        raise ValueError("'{}' is not a continuous hyperparameter.".format(name))
+
     def transform(self, params: Dict[str, Any]) -> np.ndarray:
         """Encode one parameter dict to a point in the unit hypercube."""
         x = np.empty(len(self._hparam_types), dtype=np.float64)
         for i, (name, hp_type) in enumerate(self._hparam_types.items()):
-            region = self._hparams[name]
-            v = params[name]
-            if hp_type == Searchspace.DOUBLE:
-                x[i] = (float(v) - region[0]) / (region[1] - region[0])
-            elif hp_type == Searchspace.INTEGER:
-                # map integers to bin centers so inverse rounding is stable
-                x[i] = (float(v) - region[0] + 0.5) / (region[1] - region[0] + 1)
+            if hp_type in Searchspace.CONTINUOUS_TYPES:
+                x[i] = self.encode_continuous(name, params[name])
             else:
-                idx = region.index(v)
+                region = self._hparams[name]
+                idx = region.index(params[name])
                 x[i] = (idx + 0.5) / len(region)
         return x
 
@@ -233,13 +272,10 @@ class Searchspace:
         x = np.clip(np.asarray(x, dtype=np.float64), 0.0, 1.0)
         params: Dict[str, Any] = {}
         for i, (name, hp_type) in enumerate(self._hparam_types.items()):
-            region = self._hparams[name]
-            if hp_type == Searchspace.DOUBLE:
-                params[name] = float(region[0] + x[i] * (region[1] - region[0]))
-            elif hp_type == Searchspace.INTEGER:
-                n = region[1] - region[0] + 1
-                params[name] = int(min(region[1], region[0] + int(x[i] * n)))
+            if hp_type in Searchspace.CONTINUOUS_TYPES:
+                params[name] = self.decode_continuous(name, x[i])
             else:
+                region = self._hparams[name]
                 n = len(region)
                 params[name] = region[min(n - 1, int(x[i] * n))]
         return params
@@ -258,7 +294,7 @@ class Searchspace:
         (reference TPE var_type construction, `tpe.py:180-189`)."""
         out = []
         for hp_type in self._hparam_types.values():
-            out.append("c" if hp_type in (Searchspace.DOUBLE, Searchspace.INTEGER) else "u")
+            out.append("c" if hp_type in Searchspace.CONTINUOUS_TYPES else "u")
         return out
 
     @staticmethod

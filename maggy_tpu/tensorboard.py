@@ -214,7 +214,9 @@ def _experiment_pb(searchspace):
     infos = []
     for name, spec in searchspace.to_dict().items():
         hp_type, region = spec["type"], spec["values"]
-        if hp_type in ("DOUBLE", "INTEGER"):
+        from maggy_tpu.searchspace import Searchspace
+
+        if hp_type in Searchspace.CONTINUOUS_TYPES:
             infos.append(api_pb2.HParamInfo(
                 name=name, type=api_pb2.DATA_TYPE_FLOAT64,
                 domain_interval=api_pb2.Interval(

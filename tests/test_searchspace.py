@@ -115,3 +115,53 @@ class TestProtocol:
         sp = make_space()
         sp2 = Searchspace.from_dict(sp.to_dict())
         assert sp2.to_dict() == sp.to_dict()
+
+
+class TestDoubleLog:
+    """DOUBLE_LOG: log-uniform continuous type (extension beyond the
+    reference's four types — the right prior for lr/weight-decay)."""
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="positive"):
+            Searchspace(lr=("DOUBLE_LOG", [0.0, 1.0]))
+        with pytest.raises(ValueError, match="positive"):
+            Searchspace(lr=("DOUBLE_LOG", [-1.0, 1.0]))
+        sp = Searchspace(lr=("DOUBLE_LOG", [1e-5, 1e-1]))
+        assert sp.get_type("lr") == Searchspace.DOUBLE_LOG
+
+    def test_sampling_is_log_uniform(self):
+        import numpy as np
+
+        sp = Searchspace(lr=("DOUBLE_LOG", [1e-4, 1.0]))
+        rng = np.random.default_rng(0)
+        draws = [p["lr"] for p in sp.get_random_parameter_values(4000, rng=rng)]
+        assert all(1e-4 <= v <= 1.0 for v in draws)
+        # Log-uniform: each decade gets ~1/4 of the mass (a LINEAR uniform
+        # would put ~99.99% of draws above 1e-3 and fail this hard).
+        logs = np.log10(draws)
+        for lo in (-4, -3, -2, -1):
+            frac = np.mean((logs >= lo) & (logs < lo + 1))
+            assert 0.2 < frac < 0.3, (lo, frac)
+
+    def test_transform_round_trip(self):
+        sp = Searchspace(lr=("DOUBLE_LOG", [1e-5, 1e-1]),
+                         units=("INTEGER", [8, 64]))
+        params = {"lr": 3e-4, "units": 32}
+        x = sp.transform(params)
+        assert 0.0 <= x[0] <= 1.0
+        back = sp.inverse_transform(x)
+        assert back["lr"] == pytest.approx(3e-4, rel=1e-9)
+        assert back["units"] == 32
+
+    def test_transform_is_linear_in_log_space(self):
+        sp = Searchspace(lr=("DOUBLE_LOG", [1e-4, 1.0]))
+        # Geometric midpoint encodes to 0.5 (a linear codec would give ~0.01).
+        assert sp.transform({"lr": 1e-2})[0] == pytest.approx(0.5)
+
+    def test_counts_as_continuous(self):
+        sp = Searchspace(lr=("DOUBLE_LOG", [1e-4, 1.0]))
+        assert sp.var_types() == ["c"]
+        from maggy_tpu.optimizers import RandomSearch
+
+        from tests.test_optimizers import wire
+        wire(RandomSearch(seed=0), sp, 3)  # passes the continuous guard
