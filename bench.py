@@ -96,7 +96,7 @@ def run_framework_sweep(num_trials=18, workers=3):
     from maggy_tpu import OptimizationConfig, Searchspace, experiment
     from maggy_tpu.optimizers import Asha
 
-    sp = Searchspace(lr=("DOUBLE", [1e-4, 3e-2]),
+    sp = Searchspace(lr=("DOUBLE_LOG", [1e-4, 3e-2]),
                      batch=("DISCRETE", BATCH_CHOICES))
     # ASHA multi-fidelity schedule + median-rule mid-trial early stopping:
     # the two async control loops the reference pitches against stage-based
