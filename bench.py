@@ -16,7 +16,11 @@ reference's own pitch positions async scheduling against
   scheduler could produce (it needs the outcomes before running them). The
   framework-to-oracle ratio isolates pure scheduling+control overhead.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Output contract: up to TWO JSON lines on stdout — the headline
+{"metric", "value", "unit", "vs_baseline"} printed before any extra bench
+touches the device, then (when extras ran) an enriched line with the SAME
+headline values plus extras merged into "detail". A consumer taking either
+the first or the last JSON line reads the same headline numbers.
 """
 
 from __future__ import annotations
@@ -38,7 +42,7 @@ def make_data(n=2048, key=0):
 
 
 DATA_X, DATA_Y = make_data()
-STEPS_PER_BUDGET = 40
+STEPS_PER_BUDGET = int(os.environ.get("BENCH_STEPS", "40"))
 # Swept batch sizes: trial DURATION varies ~4x across the space — the
 # normal shape of a real sweep (batch/width/depth hparams change cost), and
 # precisely what stage-based execution pays for: every synchronized wave
@@ -74,7 +78,7 @@ def train_mnist(lr, batch=256, budget=1, reporter=None):
         _bench_loss, mesh, strategy="dp", step_key=("bench_mnist", "adam"),
     )
     trainer.init(jax.random.key(0), (jnp.zeros((1, 16, 16, 1)),))
-    steps = int(STEPS_PER_BUDGET * budget)
+    steps = max(1, int(STEPS_PER_BUDGET * budget))
     it = iter(ShardedBatchIterator({"x": DATA_X, "y": DATA_Y},
                                    batch_size=int(batch), epochs=None, seed=1))
     loss = None
@@ -92,7 +96,9 @@ def train_mnist(lr, batch=256, budget=1, reporter=None):
     return {"metric": -float(loss)}
 
 
-def run_framework_sweep(num_trials=18, workers=3):
+def run_framework_sweep(num_trials=None, workers=3):
+    if num_trials is None:
+        num_trials = int(os.environ.get("BENCH_NUM_TRIALS", "18"))
     from maggy_tpu import OptimizationConfig, Searchspace, experiment
     from maggy_tpu.optimizers import Asha
 
@@ -166,12 +172,6 @@ def run_sync_sha_baseline(rung_schedule, workers=3):
 
 def log(msg):
     print("[bench] {}".format(msg), file=sys.stderr, flush=True)
-
-
-# Set when an extra bench was abandoned mid-native-call: interpreter
-# teardown with that thread alive aborts (pybind exception across exit), so
-# main() hard-exits after flushing instead.
-_ABANDONED_WORKER = False
 
 
 def handoff_gaps(trials):
@@ -380,77 +380,26 @@ def bench_flash_vs_xla():
     return out
 
 
-def run_extra_benches():
-    """MFU + kernel measurements; each is best-effort AND wall-clock
-    bounded so neither a failure nor a hang (compile stall, OOM thrash,
-    wedged device op) can take down the headline metric line. Each bench
-    runs on a daemon worker thread joined with a timeout: a stall inside
-    native XLA code cannot be interrupted, but the main thread walks away
-    and still prints the headline JSON (a signal-based timeout could not
-    deliver that — CPython only raises between bytecodes). After one
-    timeout the remaining benches are skipped: they share the (possibly
-    wedged) device."""
-    import threading
-
-    extras = {}
-    if os.environ.get("BENCH_SKIP_EXTRAS") == "1":
-        return extras
-    budget_s = float(os.environ.get("BENCH_EXTRA_TIMEOUT_S", "420"))
-    # Overall cap across all extras: the driver bounds the whole bench run,
-    # and losing the headline metric to slow extras would invert priorities.
-    # NOTE the timeout is a last resort for a genuinely wedged device:
-    # abandoning a thread mid-TPU-call leaves a stale client claim on the
-    # tunneled chip that can wedge it for FUTURE processes too, so the
-    # per-bench budget is generous and the benches themselves are sized to
-    # finish far inside it.
-    total_s = float(os.environ.get("BENCH_EXTRA_TOTAL_S", "600"))
-    started = time.time()
-
-    benches = [("llama", bench_llama_mfu), ("bert", bench_bert_mfu),
-               ("flash_vs_xla", bench_flash_vs_xla)]
-    for i, (name, fn) in enumerate(benches):
-        remaining = total_s - (time.time() - started)
-        if remaining <= 5:
-            extras[name] = {"error": "skipped: extras total budget spent"}
-            log("{} bench skipped (total extras budget {}s spent)".format(
-                name, total_s))
-            continue
-        box = {}
-
-        def target(fn=fn, box=box):
-            try:
-                box["result"] = fn()
-            except Exception as e:  # noqa: BLE001
-                box["error"] = e
-
-        t0 = time.time()
-        worker = threading.Thread(target=target, daemon=True,
-                                  name="bench-{}".format(name))
-        worker.start()
-        waited = min(budget_s, remaining)
-        worker.join(waited)
-        if worker.is_alive():
-            global _ABANDONED_WORKER
-            _ABANDONED_WORKER = True
-            extras[name] = {"error": "timeout: still running after {:.0f}s".format(waited)}
-            for later, _ in benches[i + 1:]:
-                extras[later] = {"error": "skipped: {} timed out (device may "
-                                          "be wedged)".format(name)}
-            log("{} bench TIMED OUT after {:.0f}s; skipping remaining extra "
-                "benches (device may be wedged)".format(name, waited))
-            break
-        if "error" in box:
-            extras[name] = {"error": repr(box["error"])}
-            log("{} bench FAILED: {!r}".format(name, box["error"]))
-        else:
-            extras[name] = box["result"]
-            log("{} bench done in {:.1f}s: {}".format(
-                name, time.time() - t0, box["result"]))
-    return extras
+EXTRA_BENCHES = {
+    "llama": bench_llama_mfu,
+    "bert": bench_bert_mfu,
+    "flash_vs_xla": bench_flash_vs_xla,
+}
 
 
-def main():
-    os.environ.setdefault("MAGGY_TPU_BASE_DIR", tempfile.mkdtemp(prefix="bench_"))
+HEADLINE_METRIC = "ASHA trials/hour (MNIST CNN sweep, 1 chip, 3 concurrent runners)"
+HEADLINE_UNIT = "trials/hour"
+
+
+def _failure_artifact(error):
+    return {
+        "metric": HEADLINE_METRIC,
+        "value": 0.0, "unit": HEADLINE_UNIT, "vs_baseline": 0.0,
+        "detail": {"error": error},
+    }
+
+
+def _force_cpu_if_requested():
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # Env vars alone lose to an already-imported TPU plugin
         # (sitecustomize); force the live config like __graft_entry__ does.
@@ -460,33 +409,22 @@ def main():
             jax.config.update("jax_platforms", "cpu")
         except Exception:  # noqa: BLE001
             pass
+
+
+def headline_main():
+    """Child process: warm-up, framework sweep, stage-based baselines.
+    Prints the headline JSON line (no extras) on stdout."""
+    os.environ.setdefault("MAGGY_TPU_BASE_DIR", tempfile.mkdtemp(prefix="bench_"))
+    _force_cpu_if_requested()
     from maggy_tpu.util import enable_compile_cache
 
     enable_compile_cache()
     import jax
 
-    # Bounded device probe: a wedged tunneled chip hangs jax.devices()
-    # forever — emit a well-formed failure artifact instead of nothing.
-    import threading
-
-    probe = {}
-
-    def _probe():
-        probe["devices"] = jax.devices()
-
-    t = threading.Thread(target=_probe, daemon=True)
-    t.start()
-    t.join(float(os.environ.get("BENCH_DEVICE_PROBE_S", "300")))
-    if "devices" not in probe:
-        print(json.dumps({
-            "metric": "ASHA trials/hour (MNIST CNN sweep, 1 chip, 3 concurrent runners)",
-            "value": 0.0, "unit": "trials/hour", "vs_baseline": 0.0,
-            "detail": {"error": "device unavailable: jax.devices() did not "
-                                "return within the probe budget"},
-        }), flush=True)
-        sys.stderr.flush()
-        os._exit(1)
-    log("devices: {}".format(probe["devices"]))
+    # Device availability was already probed by the orchestrator in a fresh
+    # process; a wedged chip hanging here is bounded by the orchestrator's
+    # child timeout (and the failure artifact is printed there).
+    log("devices: {}".format(jax.devices()))
 
     # Warm-up: compile every step shape (one per batch choice) so both
     # measurements see a warm cache (the persistent compilation cache does
@@ -546,12 +484,10 @@ def main():
     log("oracle replay (packed, no barriers, min of 2): {} trials in {:.1f}s".format(
         len(schedule), oracle_wall))
 
-    extras = run_extra_benches()
-
     print(json.dumps({
-        "metric": "ASHA trials/hour (MNIST CNN sweep, 1 chip, 3 concurrent runners)",
+        "metric": HEADLINE_METRIC,
         "value": round(trials_per_hour, 1),
-        "unit": "trials/hour",
+        "unit": HEADLINE_UNIT,
         "vs_baseline": round(trials_per_hour / sha_trials_per_hour, 3),
         "detail": {
             "framework_wall_s": round(wall, 1),
@@ -561,15 +497,219 @@ def main():
             "trials": n_runs,
             "early_stopped": result.get("early_stopped", 0),
             "handoff": handoff,
-            **extras,
         },
     }), flush=True)
-    if _ABANDONED_WORKER:
-        # Skip interpreter teardown: a worker wedged inside a native XLA
-        # call would abort the process AFTER the JSON already printed.
-        sys.stderr.flush()
-        os._exit(0)
+    return 0
+
+
+def extra_main(name):
+    """Child process: run ONE extra bench and print its JSON on stdout."""
+    if name == "hang":  # test hook: simulates a compile stall / wedged op
+        log("hang extra: sleeping forever (test hook)")
+        time.sleep(1e9)
+        return 0
+    _force_cpu_if_requested()
+    from maggy_tpu.util import enable_compile_cache
+
+    enable_compile_cache()
+    result = EXTRA_BENCHES[name]()
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+# ------------------------------------------------------------- orchestrator
+
+def _last_json_line(text):
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def _run_child(argv, timeout_s):
+    """Run a bench child; KILL it on timeout so this process never blocks
+    or aborts on a child's device stall (the round-3 wedge came from
+    abandoning a worker *thread* mid-device-call and carrying on in the
+    same process). NOTE a killed child's TPU claim may still linger on the
+    tunneled relay — callers must re-probe the device after any kill and
+    skip further device work if it does not come back.
+
+    Returns (status, payload): status in {"ok", "timeout", "crash"};
+    payload is the child's last stdout JSON line, or on crash a dict with
+    the stderr tail. Child stderr is tee'd through live."""
+    import subprocess
+    import threading
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)] + argv,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    out_parts, err_tail = [], []
+
+    # Each pipe gets exactly ONE reader thread (communicate() alongside a
+    # tee thread would race it for chunks and drop most of the content).
+    def _read_out():
+        for line in proc.stdout:
+            out_parts.append(line)
+
+    def _tee_err():
+        for line in proc.stderr:
+            sys.stderr.write(line)
+            sys.stderr.flush()
+            err_tail.append(line)
+            del err_tail[:-40]
+
+    readers = [threading.Thread(target=_read_out, daemon=True),
+               threading.Thread(target=_tee_err, daemon=True)]
+    for r in readers:
+        r.start()
+    try:
+        proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        return "timeout", None
+    for r in readers:  # EOF arrives once the child's pipe ends close
+        r.join(timeout=5)
+    parsed = _last_json_line("".join(out_parts))
+    if proc.returncode != 0:
+        return "crash", parsed if parsed is not None else {
+            "stderr_tail": "".join(err_tail)[-2000:]}
+    if parsed is None:
+        return "crash", {"stderr_tail": "".join(err_tail)[-2000:]}
+    return "ok", parsed
+
+
+_PROBE_CODE = """\
+import os
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+import jax
+jax.devices()
+print("ok")
+"""
+
+
+def _probe_device(timeout_s):
+    """Fresh-process device probe (the only reliable wedge detector: the
+    current process's view proves nothing about a NEW client's ability to
+    claim the chip). Honors the JAX_PLATFORMS=cpu override the same way
+    the bench children do (env alone loses to a pre-imported TPU plugin)."""
+    import subprocess
+
+    try:
+        rc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            timeout=timeout_s, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL).returncode
+        return rc == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main():
+    """Orchestrator. Never imports jax in this process — every measurement
+    runs in a killable child, so no code path here can hold (or leak) a
+    device claim. Order of output lines on stdout:
+
+    1. the headline JSON (sweep + baselines, no extras) — printed BEFORE
+       any extra bench runs, so a misbehaving extra cannot cost the
+       already-measured number;
+    2. the final enriched JSON (same headline values + extras in detail).
+
+    A consumer taking either the first or the last JSON line gets the same
+    headline numbers."""
+    # Share one base dir + compile cache across children.
+    os.environ.setdefault("MAGGY_TPU_BASE_DIR", tempfile.mkdtemp(prefix="bench_"))
+
+    if not _probe_device(float(os.environ.get("BENCH_DEVICE_PROBE_S", "300"))):
+        print(json.dumps(_failure_artifact(
+            "device unavailable: jax.devices() did not return within the "
+            "probe budget")), flush=True)
+        return 1
+
+    status, headline = _run_child(
+        ["--headline"], float(os.environ.get("BENCH_HEADLINE_TIMEOUT_S", "2400")))
+    if status == "timeout":
+        print(json.dumps(_failure_artifact(
+            "headline child timed out and was killed")), flush=True)
+        return 1
+    if headline is None or "metric" not in headline:
+        detail = "headline child crashed without emitting JSON"
+        if isinstance(headline, dict) and headline.get("stderr_tail"):
+            detail += ": " + headline["stderr_tail"][-500:]
+        print(json.dumps(_failure_artifact(detail)), flush=True)
+        return 1
+    # Print the headline IMMEDIATELY — before extras can touch the device.
+    print(json.dumps(headline), flush=True)
+    if status == "crash" or headline.get("value", 0) == 0:
+        return 1
+
+    extras = run_extra_benches()
+    if extras:
+        enriched = dict(headline)
+        enriched["detail"] = {**headline.get("detail", {}), **extras}
+        print(json.dumps(enriched), flush=True)
+    return 0
+
+
+def run_extra_benches():
+    """MFU + kernel measurements, each in its own killable subprocess so a
+    compile stall or wedged device op can neither abort this process nor
+    leak a device claim. After a timeout, a fresh-process probe decides
+    whether the chip survived; remaining extras are skipped if not."""
+    extras = {}
+    if os.environ.get("BENCH_SKIP_EXTRAS") == "1":
+        return extras
+    names = [n.strip() for n in os.environ.get(
+        "BENCH_EXTRAS", "llama,bert,flash_vs_xla").split(",") if n.strip()]
+    budget_s = float(os.environ.get("BENCH_EXTRA_TIMEOUT_S", "420"))
+    total_s = float(os.environ.get("BENCH_EXTRA_TOTAL_S", "900"))
+    started = time.time()
+    device_ok = True
+    for name in names:
+        if name not in EXTRA_BENCHES and name != "hang":
+            extras[name] = {"error": "unknown extra (valid: {})".format(
+                ",".join(EXTRA_BENCHES))}
+            continue
+        if not device_ok:
+            extras[name] = {"error": "skipped: device did not recover after "
+                                     "a previous extra was killed"}
+            continue
+        remaining = total_s - (time.time() - started)
+        if remaining <= 5:
+            extras[name] = {"error": "skipped: extras total budget spent"}
+            log("{} bench skipped (total extras budget {}s spent)".format(
+                name, total_s))
+            continue
+        t0 = time.time()
+        status, payload = _run_child(["--extra", name], min(budget_s, remaining))
+        if status == "ok":
+            extras[name] = payload
+            log("{} bench done in {:.1f}s: {}".format(
+                name, time.time() - t0, payload))
+        elif status == "timeout":
+            extras[name] = {"error": "timeout: killed after {:.0f}s".format(
+                time.time() - t0)}
+            log("{} bench TIMED OUT and was killed; probing device".format(name))
+            device_ok = _probe_device(
+                float(os.environ.get("BENCH_POSTKILL_PROBE_S", "120")))
+            log("post-kill device probe: {}".format(
+                "ok" if device_ok else "FAILED — skipping remaining extras"))
+        else:
+            tail = (payload or {}).get("stderr_tail", "")
+            extras[name] = {"error": "crashed: {}".format(tail[-500:] or payload)}
+            log("{} bench CRASHED: {}".format(name, tail[-1000:] or payload))
+    return extras
 
 
 if __name__ == "__main__":
+    if "--headline" in sys.argv:
+        sys.exit(headline_main())
+    if "--extra" in sys.argv:
+        sys.exit(extra_main(sys.argv[sys.argv.index("--extra") + 1]))
     sys.exit(main())

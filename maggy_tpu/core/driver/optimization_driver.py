@@ -216,6 +216,9 @@ class OptimizationDriver(Driver):
         every finalized trial.json from the experiment dir, rebuild result
         aggregates, and let the controller drop already-executed configs.
         The interrupted run's unfinished trials simply re-run."""
+        swept = self.env.sweep_tmp_files(self.exp_dir)
+        if swept:
+            self._log("resume: swept {} orphaned tmp file(s)".format(swept))
         restored: List[Trial] = []
         for name in sorted(self.env.ls(self.exp_dir)):
             path = "{}/{}/trial.json".format(self.exp_dir, name)

@@ -47,6 +47,12 @@ class AbstractEnv(ABC):
     def delete(self, path: str, recursive: bool = False) -> None:
         raise NotImplementedError
 
+    def sweep_tmp_files(self, path: str) -> int:
+        """Collect write artifacts orphaned by a crashed run under
+        ``path``. Default: nothing to do (backends whose dump() writes
+        in one shot leave no artifacts)."""
+        return 0
+
     # -------------------------------------------------------------- registry
 
     def experiment_base_dir(self) -> str:
@@ -113,9 +119,43 @@ class LocalEnv(AbstractEnv):
         import threading
 
         tmp = "{}.tmp.{}.{}".format(path, os.getpid(), threading.get_ident())
-        with open(tmp, "w") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        try:
+            with open(tmp, "w") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            # Don't orphan the tmp file on a failed write/replace; a hard
+            # kill can still leave one — sweep_tmp_files() at resume
+            # startup collects those.
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def sweep_tmp_files(self, path: str, grace_s: float = 120.0) -> int:
+        """Remove orphaned atomic-dump tmp files ('<name>.tmp.<pid>.<tid>')
+        left by processes that died between write and rename. Called at
+        resume startup. Only files older than ``grace_s`` are collected: a
+        LIVE writer (e.g. a runner that outlived a crashed driver) holds
+        its tmp for milliseconds between write and rename, so the age
+        check — not the pid/tid suffix, which only prevents name
+        collisions — is what makes the sweep safe against unlinking a
+        write in flight."""
+        import glob as _glob
+        import time as _time
+
+        removed = 0
+        cutoff = _time.time() - grace_s
+        for tmp in _glob.glob(os.path.join(path, "**", "*.tmp.*"),
+                              recursive=True):
+            try:
+                if os.path.getmtime(tmp) < cutoff:
+                    os.unlink(tmp)
+                    removed += 1
+            except OSError:
+                pass
+        return removed
 
     def load(self, path: str) -> str:
         with open(path) as f:
@@ -196,6 +236,9 @@ class GCSEnv(LocalEnv):
         self.fs.makedirs(path, exist_ok=True)
 
     def dump(self, data: str, path: str) -> None:
+        # One-shot object write: object stores commit the whole object on
+        # close (old-or-nothing), so no tmp+rename dance — and no rename
+        # exists on GCS anyway. sweep_tmp_files() stays the base no-op.
         with self.fs.open(path, "w") as f:
             f.write(data)
 
@@ -226,3 +269,9 @@ class GCSEnv(LocalEnv):
     def delete(self, path: str, recursive: bool = False) -> None:
         if self.fs.exists(path):
             self.fs.rm(path, recursive=recursive)
+
+    def sweep_tmp_files(self, path: str) -> int:
+        # Explicit no-op (NOT LocalEnv's local-glob sweep, which would be
+        # path-typed wrong for gs:// dirs): GCS dump() writes one-shot
+        # objects, so there are never tmp artifacts to collect.
+        return 0

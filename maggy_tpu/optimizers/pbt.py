@@ -232,7 +232,17 @@ class PBT(AbstractOptimizer):
 
     def restore(self, finalized) -> None:
         """Rebuild the schedule from a previous run; in-flight segments at
-        crash time are re-derived as their parents' successors below."""
+        crash time are re-derived as their parents' successors below.
+
+        Error state (``_errors``/``_dead``) is deliberately NOT restored:
+        only FINALIZED trials survive a crash (ERRORED segments write no
+        final_metric, so the driver's resume never hands them back), so the
+        retry ledger is unrecoverable. A member retired by the
+        two-consecutive-error rule therefore re-enters with a FRESH retry
+        budget on resume — the lineage re-runs from its last finalized
+        state and gets retired again after two further errors if it is
+        deterministically broken. Bounded re-work, never a livelock within
+        one run."""
         # Drop initial segments whose member already ran generation 0.
         done0 = {t.info_dict.get("member") for t in finalized
                  if t.info_dict.get("generation", 0) == 0}

@@ -1,0 +1,104 @@
+"""Wedge-proofing contract for bench.py (VERDICT r3 item 2).
+
+The round-3 incident: an extra bench blew its compile budget, its worker
+*thread* was abandoned mid-device-call, and the stale client claim wedged
+the chip for every later process — including the judged bench run. The
+orchestrator rewrite makes that structurally impossible:
+
+- the orchestrator process never imports jax (cannot hold a claim);
+- the headline JSON prints BEFORE any extra bench touches the device;
+- each extra runs in its own subprocess KILLED on timeout (a dead process
+  releases its device claim; an abandoned thread does not).
+
+This test forces the failure mode with a fake hanging extra and asserts
+the headline survives, the process exits 0, and a fresh process can still
+initialize the device backend afterwards.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _json_lines(text):
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            out.append(json.loads(line))
+    return out
+
+
+def test_bench_survives_hanging_extra(tmp_path):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "MAGGY_TPU_BASE_DIR": str(tmp_path),
+        # Small-but-real headline: full sweep + both baselines on CPU.
+        "BENCH_STEPS": "2",
+        "BENCH_NUM_TRIALS": "9",  # ASHA rf=3, 3 rungs needs >= 9
+        # Only the injected hanging extra runs; it must be killed at ~3s.
+        "BENCH_EXTRAS": "hang",
+        "BENCH_EXTRA_TIMEOUT_S": "3",
+        "BENCH_DEVICE_PROBE_S": "120",
+        "BENCH_HEADLINE_TIMEOUT_S": "900",
+    })
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    lines = _json_lines(proc.stdout)
+    assert len(lines) == 2, proc.stdout
+    headline, enriched = lines
+
+    # Headline printed before extras, and untouched by the hang.
+    assert headline["value"] > 0
+    assert headline["vs_baseline"] > 0
+    assert "hang" not in headline["detail"]
+
+    # Enriched line keeps the same headline numbers and records the kill.
+    assert enriched["value"] == headline["value"]
+    assert enriched["vs_baseline"] == headline["vs_baseline"]
+    assert enriched["detail"]["hang"]["error"].startswith("timeout")
+
+    # The device backend still initializes in a fresh process: the hang
+    # was killed, not abandoned, so no stale claim survives it. Uses the
+    # same CPU-honoring probe code as the orchestrator (a bare
+    # `import jax` can still touch a real device via sitecustomize's
+    # pre-registered TPU plugin, even with JAX_PLATFORMS=cpu).
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    rc = subprocess.run(
+        [sys.executable, "-c", bench._PROBE_CODE],
+        env=env, timeout=120, stdout=subprocess.DEVNULL).returncode
+    assert rc == 0
+
+
+def test_bench_headline_timeout_emits_failure_artifact(tmp_path):
+    """A hung headline child is killed and a well-formed zero-value
+    artifact is still printed (rc 1, parseable JSON — never a hang)."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "MAGGY_TPU_BASE_DIR": str(tmp_path),
+        "BENCH_STEPS": "2",
+        "BENCH_NUM_TRIALS": "9",
+        "BENCH_DEVICE_PROBE_S": "120",
+        # Headline cannot finish warm-up in 2s -> timeout path.
+        "BENCH_HEADLINE_TIMEOUT_S": "2",
+        "BENCH_SKIP_EXTRAS": "1",
+    })
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1
+    lines = _json_lines(proc.stdout)
+    assert len(lines) == 1
+    assert lines[0]["value"] == 0.0
+    assert "timed out" in lines[0]["detail"]["error"]

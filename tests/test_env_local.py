@@ -1,0 +1,51 @@
+"""LocalEnv atomic-dump hygiene (ADVICE r3): failed dumps must not orphan
+tmp files, and resume startup sweeps any left by hard-killed writers."""
+
+import os
+import time
+
+import pytest
+
+from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+
+
+def test_dump_failure_unlinks_tmp(tmp_path, monkeypatch):
+    env = LocalEnv(base_dir=str(tmp_path))
+    target = str(tmp_path / "exp" / "trial.json")
+
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("simulated crash at rename")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        env.dump("{}", target)
+    monkeypatch.setattr(os, "replace", real_replace)
+
+    leftovers = [f for f in os.listdir(tmp_path / "exp") if ".tmp." in f]
+    assert leftovers == []
+
+
+def test_sweep_collects_orphans_and_spares_artifacts(tmp_path):
+    env = LocalEnv(base_dir=str(tmp_path))
+    exp = tmp_path / "exp" / "t0"
+    exp.mkdir(parents=True)
+    # A real artifact and two orphans from a "killed" writer.
+    env.dump("{}", str(exp / "trial.json"))
+    (exp / "trial.json.tmp.999.888").write_text("torn")
+    (tmp_path / "exp" / "result.json.tmp.1.2").write_text("torn")
+    # A FRESH tmp file models a live writer mid-dump (a runner that
+    # outlived a crashed driver): the grace window must spare it.
+    (exp / "live.json.tmp.3.4").write_text("in flight")
+    old = time.time() - 600
+    os.utime(exp / "trial.json.tmp.999.888", (old, old))
+    os.utime(tmp_path / "exp" / "result.json.tmp.1.2", (old, old))
+
+    removed = env.sweep_tmp_files(str(tmp_path / "exp"))
+
+    assert removed == 2
+    assert (exp / "trial.json").exists()
+    assert not (exp / "trial.json.tmp.999.888").exists()
+    assert (exp / "live.json.tmp.3.4").exists()
+    assert env.sweep_tmp_files(str(tmp_path / "exp")) == 0

@@ -429,6 +429,19 @@ class TestLazyMetrics:
                     rep.broadcast(val, step=step)
                     if step % 7 == 0:
                         val.ready = True
+            # Deterministic observation window: on a loaded host the
+            # reader thread may never get scheduled during the writer
+            # loop (round-3 flake — observed stayed 0). Keep the last
+            # trial's stream alive until the reader has sampled at
+            # least one pair, then stop.
+            deadline = time.time() + 30
+            step = 20
+            while observed[0] == 0 and not reader_errors \
+                    and time.time() < deadline:
+                rep.broadcast(self._FakeDeviceScalar(
+                    1000.0 * 49 + (step % 1000), ready=True), step=step)
+                step += 1
+                time.sleep(0.001)
         finally:
             stop.set()
             hb.join(timeout=10)
