@@ -1,6 +1,11 @@
 """Titanic-style tabular LOCO ablation study — the reference's ablation
 example notebook, TPU-native with declarative specs.
 
+The dataset rides in a ``.tfrecord`` file consumed through the study's
+``train_set`` path — the same feature-store format + built-in
+feature-dropping pipeline the reference's LOCO used
+(reference ``loco.py:41-80``), with no TensorFlow import.
+
 Run: python examples/titanic_ablation.py
 """
 
@@ -36,13 +41,14 @@ def make_titanic_like(n=2048, seed=0):
     return X, y
 
 
-X_ALL, Y = make_titanic_like()
+def write_dataset_tfrecord(path):
+    """Persist the dataset as tf.train.Example records (one per row)."""
+    from maggy_tpu.train.tfrecord import write_tfrecord
 
-
-def dataset_generator(ablated_feature=None):
-    cols = [f for f in FEATURES if f != ablated_feature]
-    X = np.stack([X_ALL[c] for c in cols], axis=1)
-    return {"X": X, "y": Y, "columns": cols}
+    X, y = make_titanic_like()
+    write_tfrecord(path, (
+        {**{f: float(X[f][i]) for f in FEATURES}, "survived": int(y[i])}
+        for i in range(len(y))))
 
 
 def model_layers():
@@ -62,9 +68,15 @@ def model_generator(ablated_layers=frozenset()):
 
 def train_fn(dataset_function, model_function, ablated_feature, ablated_layer,
              reporter=None):
+    # dataset_function() is the built-in feature dropper over the study's
+    # train_set tfrecord: a dict of per-feature arrays (minus the ablated
+    # one) plus the label column.
     data = dataset_function()
     model = model_function()
-    X, y = jnp.asarray(data["X"]), jnp.asarray(data["y"])
+    y = np.asarray(data.pop("survived"), dtype=np.int32)
+    cols = sorted(data)
+    X = jnp.asarray(np.stack([data[c] for c in cols], axis=1))
+    y = jnp.asarray(y)
     params = model.init(jax.random.key(0), X[:1])
     tx = optax.adam(1e-2)
     opt = tx.init(params)
@@ -91,8 +103,12 @@ def train_fn(dataset_function, model_function, ablated_feature, ablated_layer,
 
 
 def main():
-    study = AblationStudy("titanic", 1, "survived",
-                          dataset_generator=dataset_generator)
+    import tempfile
+
+    data_path = _os.path.join(tempfile.mkdtemp(prefix="titanic_"),
+                              "titanic.tfrecord")
+    write_dataset_tfrecord(data_path)
+    study = AblationStudy("titanic", 1, "survived", train_set=data_path)
     study.features.include(*FEATURES)
     study.model.set_base_model_generator(model_generator)
     study.model.layers.include("hidden_1", "hidden_2")

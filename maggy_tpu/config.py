@@ -61,6 +61,12 @@ class OptimizationConfig(LagomConfig):
     chips_per_trial: int = 1
     # Capture a jax.profiler trace per trial into its TensorBoard dir.
     profile: bool = False
+    # Tee the user train_fn's print() calls into the reporter log channel,
+    # streaming them to the driver/monitor on heartbeats (the reference
+    # ships prints to Jupyter by patching builtins.print,
+    # `trial_executor.py:71-81`). Off by default: reporter.log() is the
+    # explicit channel; this flag restores the reference behavior.
+    ship_prints: bool = False
     # Declare a runner lost after this many seconds of heartbeat silence
     # while holding a trial (its trial is requeued to another runner).
     # None -> max(HEARTBEAT_LOSS_MIN_S, hb_interval * HEARTBEAT_LOSS_FACTOR).

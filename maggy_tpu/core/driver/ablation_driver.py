@@ -60,6 +60,7 @@ class AblationDriver(OptimizationDriver):
             trial_type="ablation",
             ablation_resolver=self.controller.make_resolver(),
             profile=getattr(self.config, "profile", False),
+            ship_prints=getattr(self.config, "ship_prints", False),
         )
 
     def _exp_startup_callback(self) -> None:

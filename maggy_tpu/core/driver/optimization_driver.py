@@ -198,6 +198,7 @@ class OptimizationDriver(Driver):
             train_fn=train_fn,
             trial_type="optimization",
             profile=getattr(self.config, "profile", False),
+            ship_prints=getattr(self.config, "ship_prints", False),
         )
 
     def _validate_resume(self) -> None:
@@ -320,6 +321,15 @@ class OptimizationDriver(Driver):
         self.result["lost_runners"] = self.result.get("lost_runners", 0) + 1
         self._log("runner {} heartbeat lost; trial {} requeued for reassignment".format(
             msg["partition_id"], msg["trial_id"]))
+        # Reap the hung worker so it cannot block the pool's final join: a
+        # runner wedged inside a native call (compile stall, stuck device
+        # op) never returns on its own. Process pools kill just that one
+        # worker; the experiment completes on the survivors and the killed
+        # runner surfaces as a survivable pool failure.
+        pool = getattr(self, "_active_pool", None)
+        if pool is not None and pool.kill_worker(msg["partition_id"]):
+            self._log("runner {} killed after heartbeat loss (presumed "
+                      "wedged)".format(msg["partition_id"]))
 
     def _pop_requeue(self) -> Optional[Trial]:
         with self._store_lock:
@@ -556,6 +566,13 @@ class OptimizationDriver(Driver):
     def progress_snapshot(self) -> Dict[str, Any]:
         with self._store_lock:
             done = len(self._final_store)
+        with self._log_lock:
+            log_total = len(self.executor_logs)
+            log_tail = list(self.executor_logs[-20:])
         return {"num_trials": self.num_trials, "finalized": done,
                 "best_val": self.result["best_val"],
-                "early_stopped": self.result["early_stopped"]}
+                "early_stopped": self.result["early_stopped"],
+                # Executor-log stream for the monitor CLI (reference's LOG
+                # RPC carried executor prints to sparkmagic, rpc.py:369-377):
+                # total count + tail window lets a poller print only new lines.
+                "log_total": log_total, "log_tail": log_tail}

@@ -9,8 +9,11 @@ GSTOP; ablation mode resolves declarative ablation specs before the call
 (:103-108).
 
 Redesign notes:
-- no `builtins.print` monkey-patching (reference :71-81): the reporter tees
-  to the runner log explicitly; user code gets the reporter for logging.
+- `builtins.print` is NOT patched by default (reference :71-81): the
+  reporter tees to the runner log explicitly; user code gets the reporter
+  for logging. ``ship_prints=True`` opts back into the reference behavior
+  via a thread-scoped tee (prints inside train_fn also land in the
+  reporter log channel and stream to the driver/monitor on heartbeats).
 - per-trial TPU device pinning happens in the runner pool (process-level),
   not here: JAX binds devices at process start.
 """
@@ -25,6 +28,41 @@ from typing import Callable, Optional, Tuple
 
 # The JAX profiler allows one active trace per process.
 _PROFILE_LOCK = threading.Lock()
+
+# ---- opt-in print shipping (ship_prints=True) ----
+# builtins.print is process-global but runners may be THREADS sharing it,
+# so the installed tee dispatches through a thread-local: only the thread
+# currently inside a shipping trial has a reporter registered; every other
+# thread's prints pass through untouched. Installed once, never uninstalled
+# (the pass-through is free), so concurrent experiments can't race the
+# patch the way the reference's per-executor patching could.
+_print_ship = threading.local()
+_print_tee_lock = threading.Lock()
+_orig_print = None
+
+
+def _install_print_tee() -> None:
+    global _orig_print
+    with _print_tee_lock:
+        if _orig_print is not None:
+            return
+        import builtins
+        import sys
+
+        _orig_print = builtins.print
+
+        def tee_print(*args, **kwargs):
+            _orig_print(*args, **kwargs)
+            reporter = getattr(_print_ship, "reporter", None)
+            if reporter is not None and kwargs.get("file") in (None, sys.stdout):
+                try:
+                    reporter.log(
+                        str(kwargs.get("sep", " ")).join(str(a) for a in args),
+                        verbose=False)
+                except Exception:  # noqa: BLE001 - shipping must never break print
+                    pass
+
+        builtins.print = tee_print
 
 from maggy_tpu import util
 from maggy_tpu.core.environment import EnvSing
@@ -48,6 +86,7 @@ class TrialExecutor:
         trial_type: str = "optimization",
         ablation_resolver: Optional[Callable] = None,
         profile: bool = False,
+        ship_prints: bool = False,
     ):
         self.server_addr = server_addr
         self.secret = secret
@@ -58,6 +97,7 @@ class TrialExecutor:
         self.trial_type = trial_type
         self.ablation_resolver = ablation_resolver
         self.profile = profile
+        self.ship_prints = ship_prints
 
     def __call__(self, partition_id: int) -> None:
         env = EnvSing.get_instance()
@@ -114,7 +154,7 @@ class TrialExecutor:
                         ctx = TrialContext(trial_id, trial_dir, exp_dir,
                                            params, client.last_info)
                         call_params["ctx"] = ctx
-                    retval = self._run_trial(call_params, trial_dir)
+                    retval = self._run_trial(call_params, trial_dir, reporter)
                     metric = util.handle_return_val(
                         retval, trial_dir, self.optimization_key, env
                     )
@@ -152,7 +192,7 @@ class TrialExecutor:
             client.stop()
 
 
-    def _run_trial(self, call_params: dict, trial_dir: str):
+    def _run_trial(self, call_params: dict, trial_dir: str, reporter=None):
         """Invoke the user train_fn, optionally under a `jax.profiler`
         trace (SURVEY.md §5.1: the TPU-idiomatic stand-in for the
         reference's absent profiling — traces land in the trial's
@@ -162,17 +202,23 @@ class TrialExecutor:
         an in-process thread pool tracing is best-effort: a trial whose
         start overlaps an already-traced trial runs untraced. Process/TPU
         pools have one trial per process and trace every trial."""
-        if not self.profile:
-            return self.train_fn(**call_params)
-        if not _PROFILE_LOCK.acquire(blocking=False):
-            return self.train_fn(**call_params)
+        if self.ship_prints:
+            _install_print_tee()
+            _print_ship.reporter = reporter
         try:
-            import jax
-
-            with jax.profiler.trace(os.path.join(trial_dir, "tensorboard")):
+            if not self.profile:
                 return self.train_fn(**call_params)
+            if not _PROFILE_LOCK.acquire(blocking=False):
+                return self.train_fn(**call_params)
+            try:
+                import jax
+
+                with jax.profiler.trace(os.path.join(trial_dir, "tensorboard")):
+                    return self.train_fn(**call_params)
+            finally:
+                _PROFILE_LOCK.release()
         finally:
-            _PROFILE_LOCK.release()
+            _print_ship.reporter = None
 
 
 def trial_executor_fn(**kwargs) -> TrialExecutor:

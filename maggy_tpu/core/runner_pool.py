@@ -46,6 +46,19 @@ class RunnerPool(ABC):
         wedged waiting on it. Threads cannot be killed — only process-backed
         pools act on this."""
 
+    def kill_worker(self, partition_id: int) -> bool:
+        """Kill ONE hung worker (best effort), leaving the rest of the pool
+        running. Called by heartbeat-loss detection: a runner wedged inside
+        an uninterruptible native call (XLA compile, a stuck device op)
+        stops heartbeating but never returns, and without this its
+        process would block the pool's final join forever — the hang case
+        Spark's task-retry machinery covered for free in the reference.
+        Returns True if a worker was actually killed. Thread pools cannot
+        kill (Python threads are not interruptible): they return False and
+        rely on the requeue alone, so wedge-resilience needs a process
+        pool ('process'/'tpu')."""
+        return False
+
 
 class ThreadRunnerPool(RunnerPool):
     def run(self, worker_fn: Callable[[int], None]) -> List[BaseException]:
@@ -114,6 +127,17 @@ class ProcessRunnerPool(RunnerPool):
         for p in self._procs:
             if p.is_alive():
                 p.terminate()
+
+    def kill_worker(self, partition_id: int) -> bool:
+        # SIGKILL, not SIGTERM: a SIGSTOPped or native-wedged process never
+        # runs a TERM handler (for a stopped process TERM stays pending
+        # until SIGCONT), while KILL reaps it unconditionally.
+        if 0 <= partition_id < len(self._procs):
+            p = self._procs[partition_id]
+            if p.is_alive():
+                p.kill()
+                return True
+        return False
 
     def run(self, worker_fn: Callable[[int], None]) -> List[BaseException]:
         ctx = mp.get_context(self.start_method)

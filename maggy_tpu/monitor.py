@@ -62,6 +62,9 @@ def main(argv=None) -> int:
                    help="seconds between polls (default 2)")
     p.add_argument("--once", action="store_true",
                    help="print one snapshot and exit")
+    p.add_argument("--logs", action="store_true",
+                   help="also stream executor log lines (reporter.log and, "
+                        "with ship_prints=True, user print() output)")
     args = p.parse_args(argv)
 
     if args.ticket:
@@ -85,6 +88,7 @@ def main(argv=None) -> int:
 
     polled_ok = False
     consecutive_failures = 0
+    logs_seen = 0
     while True:
         try:
             snap = poll_progress(addr, secret)
@@ -104,6 +108,13 @@ def main(argv=None) -> int:
         consecutive_failures = 0
         polled_ok = True
         print(render(snap), flush=True)
+        if args.logs:
+            total = snap.get("log_total", 0)
+            tail = snap.get("log_tail", [])
+            new = min(total - logs_seen, len(tail))
+            for line in (tail[-new:] if new > 0 else []):
+                print("  | {}".format(line), flush=True)
+            logs_seen = max(logs_seen, total)
         if args.once:
             return 0
         time.sleep(args.interval)

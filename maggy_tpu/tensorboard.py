@@ -113,6 +113,20 @@ class _EventWriter:
         _force_tb_stub()
         from tensorboard.summary.writer.event_file_writer import EventFileWriter
 
+        # Remote logdirs (gs://...) are STAGED locally and uploaded through
+        # the experiment env's filesystem at close. EventFileWriter's own
+        # remote support resolves gs:// via a fresh gcsfs client — not the
+        # env's (possibly injected/authenticated) fs — and its writer
+        # thread BLOCKS the experiment forever when that client can't
+        # reach the bucket. Trade-off: no live remote tail; event files
+        # land whole at trial/experiment end.
+        self._remote_dir = None
+        if _is_remote(logdir):
+            import tempfile
+
+            self._remote_dir = logdir
+            logdir = tempfile.mkdtemp(prefix="maggy_tb_staging_")
+        self._staging_dir = logdir
         self._writer = EventFileWriter(logdir)
 
     def _event(self, **kwargs):
@@ -144,6 +158,39 @@ class _EventWriter:
             pass
         self._writer.flush()
         self._writer.close()
+        if self._remote_dir is not None:
+            _upload_tree(self._staging_dir, self._remote_dir)
+
+
+def _is_remote(path: str) -> bool:
+    return "://" in path
+
+
+def _upload_tree(local_dir: str, remote_dir: str) -> None:
+    """Copy a staged logdir to its remote home via the experiment env's
+    filesystem (best-effort: TB artifacts must never fail a trial)."""
+    import shutil
+
+    from maggy_tpu.core.environment import EnvSing
+
+    try:
+        env = EnvSing.get_instance()
+        for root, _, files in os.walk(local_dir):
+            rel = os.path.relpath(root, local_dir)
+            for fname in files:
+                remote = "/".join(p for p in (
+                    remote_dir, "" if rel == "." else rel, fname) if p)
+                with open(os.path.join(root, fname), "rb") as src, \
+                        env.open_file(remote, "wb") as dst:
+                    # Chunked: profiler traces run to GBs; slurping would
+                    # spike runner RSS at trial close.
+                    shutil.copyfileobj(src, dst)
+    except Exception:  # noqa: BLE001
+        pass
+    finally:
+        # The staging dir exists only to be uploaded; one leaks per trial
+        # (or per trace) otherwise — /tmp is tmpfs on TPU VMs.
+        shutil.rmtree(local_dir, ignore_errors=True)
 
 
 def _make_writer(logdir: str):
@@ -157,7 +204,8 @@ def _register(trial_logdir: str) -> None:
     """Called by the trial executor (in the runner's thread) when a trial
     starts; closes this thread's previous trial writer."""
     _close()
-    os.makedirs(trial_logdir, exist_ok=True)
+    if not _is_remote(trial_logdir):
+        os.makedirs(trial_logdir, exist_ok=True)
     _state.logdir = trial_logdir
     _state.writer = _make_writer(trial_logdir)
 
@@ -185,7 +233,7 @@ def add_scalar(tag: str, value: float, step: int = 0) -> None:
     writer, current = _get("writer"), _get("logdir")
     if writer is not None:
         writer.add_scalar(tag, value, step)
-    elif current is not None:
+    elif current is not None and not _is_remote(current):
         with open(os.path.join(current, "scalars.jsonl"), "a") as f:
             f.write(json.dumps({"tag": tag, "value": float(value), "step": step}) + "\n")
 
@@ -197,7 +245,7 @@ def write_hparams(hparams: Dict[str, Any], metrics: Optional[Dict[str, float]] =
         return
     if writer is not None:
         writer.write_hparams(hparams, metrics)
-    else:
+    elif not _is_remote(current):
         with open(os.path.join(current, "hparams.json"), "w") as f:
             json.dump(hparams, f, default=str)
 
@@ -252,23 +300,40 @@ def write_experiment_config(exp_dir: str, searchspace) -> None:
         return
     try:
         pb = _experiment_pb(searchspace)
-        w = _EventWriter(os.path.join(exp_dir, "tensorboard"))
+        w = _EventWriter("/".join((exp_dir, "tensorboard"))
+                         if _is_remote(exp_dir)
+                         else os.path.join(exp_dir, "tensorboard"))
         w.write_experiment(pb)
         w._writer.flush()
         w._writer.close()
+        if w._remote_dir is not None:
+            _upload_tree(w._staging_dir, w._remote_dir)
     except Exception:  # noqa: BLE001 - TB must never block an experiment
         pass
 
 
 def start_trace(trace_dir: Optional[str] = None) -> None:
     """Capture a jax.profiler trace into the trial logdir (viewable in
-    TensorBoard's profile plugin)."""
+    TensorBoard's profile plugin). Remote logdirs are staged locally and
+    uploaded at stop_trace (same rationale as _EventWriter)."""
     import jax
 
-    jax.profiler.start_trace(trace_dir or logdir())
+    target = trace_dir or logdir()
+    if _is_remote(target):
+        import tempfile
+
+        _state.trace_staging = (tempfile.mkdtemp(prefix="maggy_trace_"), target)
+        target = _state.trace_staging[0]
+    else:
+        _state.trace_staging = None
+    jax.profiler.start_trace(target)
 
 
 def stop_trace() -> None:
     import jax
 
     jax.profiler.stop_trace()
+    staging = _get("trace_staging")
+    if staging is not None:
+        _upload_tree(staging[0], staging[1])
+        _state.trace_staging = None

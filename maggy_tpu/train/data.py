@@ -213,7 +213,7 @@ def feature_dropping_generator(source):
     """Build a LOCO ``dataset_generator``: ``gen(ablated_feature=None)``
     returns the training data as a dict of arrays minus the ablated
     feature. ``source`` is a dict of arrays or a path `load_path_dataset`
-    understands (.npz / .parquet / parquet dir); paths are loaded once per
+    understands (.npz / .parquet / .tfrecord / dirs); paths are loaded once per
     process and cached across the study's trials. Each call returns FRESH
     array copies — trials routinely normalize in place, and aliased arrays
     would leak one trial's mutations into every other (concurrent
@@ -236,13 +236,36 @@ def feature_dropping_generator(source):
 def load_path_dataset(path, columns=None, file_shard=None):
     """Load an on-disk dataset into a dict of numpy arrays.
 
-    Supported formats: a ``.npz`` archive, a single ``.parquet`` file, or a
-    directory of ``.parquet`` files. ``file_shard=(current, count)``
-    restricts a parquet directory to files ``[current::count]`` (file-level
+    Supported formats: a ``.npz`` archive, a single ``.parquet`` file, a
+    directory of ``.parquet`` files, a ``.tfrecord``/``.tfrecords`` file,
+    or a directory of them (the reference's feature-store format,
+    `loco.py:41-80`). ``file_shard=(current, count)`` restricts a
+    parquet/tfrecord directory to files ``[current::count]`` (file-level
     sharding; single files and npz archives reject it — there is nothing to
     split without reading everything anyway).
     """
     import os
+
+    from maggy_tpu.train import tfrecord as _tfr
+
+    if _tfr.is_tfrecord_path(path):
+        if os.path.isdir(path):
+            files = sorted(
+                os.path.join(path, f) for f in os.listdir(path)
+                if f.endswith((".tfrecord", ".tfrecords")))
+            if file_shard is not None:
+                current, count = file_shard
+                if count > len(files):
+                    raise ValueError(
+                        "{} shards but only {} tfrecord files; use "
+                        "shard_by='row'".format(count, len(files)))
+                files = files[current::count]
+        else:
+            if file_shard is not None and file_shard[1] > 1:
+                raise ValueError(
+                    "file-level sharding needs a tfrecord directory")
+            files = [path]
+        return _tfr.load_tfrecord_dataset(files, columns=columns)
 
     if path.endswith(".npz"):
         if file_shard is not None and file_shard[1] > 1:

@@ -82,6 +82,62 @@ class TestRegistry:
         assert meta["extra"] == 1 and meta["result"]["best"] == 2
 
 
+class TestObjectStoreResume:
+    """Resume against a RENAME-LESS backend (VERDICT r4 item 7): GCS has no
+    atomic tmp+rename, so the driver's torn-artifact tolerance — not
+    LocalEnv's os.replace — is what guarantees old-or-nothing semantics on
+    object stores. Drive a full interrupt/tear/resume cycle entirely
+    through a gs:// experiment dir."""
+
+    def test_interrupt_tear_resume_full_schedule(self, env, monkeypatch,
+                                                 tmp_path):
+        import os
+
+        from maggy_tpu import OptimizationConfig, Searchspace, experiment
+        from maggy_tpu.core.environment import EnvSing
+
+        count_dir = tmp_path / "counts"
+        count_dir.mkdir()
+        monkeypatch.setenv("MAGGY_TEST_COUNT_DIR", str(count_dir))
+        EnvSing.set_instance(env)
+        try:
+            def cfg(n, **kw):
+                return OptimizationConfig(
+                    name="gcs_resume", num_trials=n, optimizer="randomsearch",
+                    searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2]),
+                                            units=("INTEGER", [8, 64])),
+                    direction="max", num_workers=2, hb_interval=0.05,
+                    seed=5, es_policy="none",
+                    experiment_dir=BASE + "/runs", **kw)
+
+            from tests.test_resume import train_counting
+
+            r1 = experiment.lagom(train_counting, cfg(3))
+            assert r1["num_trials"] == 3
+            exp_dir = BASE + "/runs/" + env.ls(BASE + "/runs")[0]
+            # Tear one finalized artifact the way an object store can
+            # surface it (crashed writer, partial multipart): truncated
+            # JSON, no rename to hide behind.
+            torn = None
+            for name in env.ls(exp_dir):
+                p = "{}/{}/trial.json".format(exp_dir, name)
+                if env.exists(p):
+                    torn = p
+                    env.dump(env.load(p)[:17], p)
+                    break
+            assert torn is not None
+
+            r2 = experiment.lagom(train_counting, cfg(6, resume=True))
+            # 2 restored + the torn one re-ran + 3 fresh = 6 total.
+            assert r2["num_trials"] == 6
+            # The torn trial's artifact was re-written whole.
+            import json as _json
+
+            _json.loads(env.load(torn))
+        finally:
+            EnvSing.reset()
+
+
 class TestBuildSummary:
     def test_summary_over_trial_dirs(self, env):
         exp_dir = env.register_experiment("app", 0, {})

@@ -61,6 +61,27 @@ class TestCli:
         out = capsys.readouterr().out
         assert "4/10" in out
 
+    def test_logs_flag_streams_executor_lines(self, capsys):
+        """A user's print() line (shipped via ship_prints -> reporter log
+        channel -> driver executor_logs -> progress_snapshot log_tail)
+        shows up in the monitor CLI output."""
+        driver = SnapshotDriver(
+            {"num_trials": 10, "finalized": 4, "best_val": 0.925,
+             "early_stopped": 1, "log_total": 2,
+             "log_tail": ["Trial abc started", "USER_PRINT lr=0.1000"]})
+        server = OptimizationServer(num_executors=1)
+        server.attach_driver(driver)
+        addr = server.start()
+        try:
+            rc = monitor.main(["--driver", "{}:{}".format(*addr),
+                               "--secret", server.secret_hex,
+                               "--once", "--logs"])
+        finally:
+            server.stop()
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "USER_PRINT lr=0.1000" in out
+
     def test_unreachable_driver_fails_fast(self, capsys):
         rc = monitor.main(["--driver", "127.0.0.1:1",  # nothing listens there
                            "--secret", "00", "--once"])
