@@ -47,7 +47,11 @@ class OptimizationConfig(LagomConfig):
     es_interval: int = constants.DEFAULT_ES_INTERVAL
     es_min: int = constants.DEFAULT_ES_MIN
     es_policy: Union[str, Any] = constants.DEFAULT_ES_POLICY
-    num_workers: int = 1
+    # Concurrent trial runners, or "auto" to size from the runtime device
+    # inventory (one runner per local chip subset for pool="tpu", one per
+    # local device otherwise) — the reference reads its executor count
+    # from cluster conf at runtime (`hopsworks.py:236-244`).
+    num_workers: Union[int, str] = 1
     seed: Optional[int] = None
     # Runner substrate: "thread" (in-process), "process" (one JAX runtime
     # per trial), "tpu" (processes pinned to disjoint chip sub-slices),
@@ -83,6 +87,10 @@ class OptimizationConfig(LagomConfig):
             raise ValueError("direction must be 'max' or 'min', got {!r}".format(self.direction))
         if self.pool not in ("thread", "process", "tpu", "remote"):
             raise ValueError("pool must be 'thread', 'process', 'tpu', or 'remote'")
+        if isinstance(self.num_workers, str) and self.num_workers != "auto":
+            raise ValueError(
+                "num_workers must be an int or 'auto', got {!r}".format(
+                    self.num_workers))
         if self.bind_host is None and self.pool == "remote":
             self.bind_host = "0.0.0.0"
 

@@ -20,7 +20,7 @@ from maggy_tpu.config import OptimizationConfig
 from maggy_tpu.core.driver.driver import Driver
 from maggy_tpu.core.executors.trial_executor import trial_executor_fn
 from maggy_tpu.core.rpc import OptimizationServer
-from maggy_tpu.core.runner_pool import ThreadRunnerPool
+from maggy_tpu.core.runner_pool import ThreadRunnerPool, resolve_num_workers
 from maggy_tpu.earlystop import MedianStoppingRule, NoStoppingRule
 from maggy_tpu.optimizers import PBT, Asha, GridSearch, RandomSearch, SingleRun
 from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
@@ -75,7 +75,7 @@ class OptimizationDriver(Driver):
         max_conc = getattr(self.controller, "max_concurrency", None)
         ceiling = min(self.num_trials,
                       max_conc() if max_conc is not None else self.num_trials)
-        self.num_executors = min(config.num_workers, ceiling)
+        self.num_executors = min(resolve_num_workers(config), ceiling)
         super().__init__(config, app_id, run_id)
 
         # Trial bookkeeping shared with the server thread.

@@ -26,6 +26,65 @@ from abc import ABC, abstractmethod
 from typing import Callable, List, Optional
 
 
+_DEVICE_PROBE_CODE = """\
+import os, sys
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    # Env alone can lose to a site-preimported TPU plugin; force it.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+import jax
+ds = jax.local_devices()
+coords = {getattr(d, "coords", None) for d in ds}
+n_chips = len(ds) if None in coords else len(coords)
+sys.stdout.write("{} {}".format(n_chips, len(ds)))
+"""
+
+
+def _probe_local_devices(timeout_s: float = 120.0):
+    """(chips, devices) counted in a THROWAWAY subprocess. The driver
+    process must never initialize the JAX/libtpu backend itself: for
+    process/TPU pools the children pin chips via env vars read at THEIR
+    backend init, and a driver-side init would claim every local chip
+    first (the exact hazard process pools exist to avoid). Chips are
+    counted by distinct device.coords — on 2-TensorCore chips (v2/v3)
+    devices != chips and TPU_VISIBLE_CHIPS pinning is per chip."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-c", _DEVICE_PROBE_CODE],
+        timeout=timeout_s, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL).stdout
+    chips, devices = out.decode().split()
+    return int(chips), int(devices)
+
+
+def resolve_num_workers(config) -> int:
+    """``num_workers="auto"``: size the pool from the runtime device
+    inventory instead of a hardcoded count — the TPU-native analogue of
+    the reference reading the executor count from cluster conf at runtime
+    (`hopsworks.py:236-244`). One runner per local chip subset for the
+    TPU pool; one per local device otherwise. Remote pools must stay
+    explicit: agents JOIN dynamically, the driver only caps admission."""
+    nw = getattr(config, "num_workers", 1)
+    if nw != "auto":
+        return int(nw)
+    pool = getattr(config, "pool", "thread")
+    if pool == "remote":
+        raise ValueError(
+            "num_workers='auto' is for local pools; remote agents join "
+            "dynamically — set the admission cap explicitly.")
+    try:
+        chips, devices = _probe_local_devices()
+    except Exception as e:  # noqa: BLE001 - probe subprocess failed/hung
+        raise ValueError(
+            "num_workers='auto' could not probe the device inventory "
+            "({!r}); pass an explicit count.".format(e)) from e
+    if pool == "tpu":
+        return max(1, chips // max(1, getattr(config, "chips_per_trial", 1)))
+    return max(1, devices)
+
+
 class RunnerPool(ABC):
     def __init__(self, num_workers: int):
         self.num_workers = num_workers

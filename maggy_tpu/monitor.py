@@ -111,6 +111,10 @@ def main(argv=None) -> int:
         if args.logs:
             total = snap.get("log_total", 0)
             tail = snap.get("log_tail", [])
+            missed = total - logs_seen - len(tail)
+            if logs_seen and missed > 0:
+                print("  | ... {} line(s) skipped (poll faster or read the "
+                      "executor logs)".format(missed), flush=True)
             new = min(total - logs_seen, len(tail))
             for line in (tail[-new:] if new > 0 else []):
                 print("  | {}".format(line), flush=True)

@@ -92,21 +92,25 @@ def _encode_feature(values) -> bytes:
     values = list(values)
     if not values:
         return _len_delim(3, b"")  # empty int64_list
-    first = values[0]
-    if isinstance(first, (bytes, str)):
+    if all(isinstance(v, (bytes, str)) for v in values):
         inner = b"".join(
             _len_delim(1, v.encode() if isinstance(v, str) else bytes(v))
             for v in values)
         return _len_delim(1, inner)
-    if isinstance(first, (float, np.floating)):
-        inner = _tag(1, 2) + _varint(4 * len(values)) + struct.pack(
-            "<{}f".format(len(values)), *[float(v) for v in values])
-        return _len_delim(2, inner)
-    if isinstance(first, (int, np.integer, bool, np.bool_)):
+    # A single float promotes the whole list: dispatching on the first
+    # element alone would silently int()-truncate [1, 2.5] -> [1, 2].
+    if all(isinstance(v, (int, float, np.integer, np.floating, bool,
+                          np.bool_)) for v in values):
+        if any(isinstance(v, (float, np.floating)) for v in values):
+            inner = _tag(1, 2) + _varint(4 * len(values)) + struct.pack(
+                "<{}f".format(len(values)), *[float(v) for v in values])
+            return _len_delim(2, inner)
         packed = b"".join(_varint(int(v) & 0xFFFFFFFFFFFFFFFF) for v in values)
         inner = _tag(1, 2) + _varint(len(packed)) + packed
         return _len_delim(3, inner)
-    raise TypeError("Unsupported feature value type {}".format(type(first)))
+    raise TypeError(
+        "Unsupported or mixed feature value types {}".format(
+            sorted({type(v).__name__ for v in values})))
 
 
 def encode_example(features: Dict[str, Any]) -> bytes:

@@ -249,6 +249,40 @@ def train_wedged(lr, units, reporter=None):
     return {"metric": 1.0 - (lr - 0.1) ** 2}
 
 
+class TestAutoWorkers:
+    def test_auto_sizes_pool_from_device_inventory(self, local_env):
+        config = OptimizationConfig(
+            name="auto_w", num_trials=8, optimizer="randomsearch",
+            searchspace=space(), direction="max", num_workers="auto",
+            hb_interval=0.05, seed=2, es_policy="none")
+        result = experiment.lagom(train_quadratic, config)
+        assert result["num_trials"] == 8
+
+    def test_resolve_counts(self):
+        import types
+
+        from maggy_tpu.core.runner_pool import resolve_num_workers
+
+        import jax
+
+        n = jax.local_device_count()
+        cfg = types.SimpleNamespace(num_workers="auto", pool="thread")
+        assert resolve_num_workers(cfg) == n
+        cfg = types.SimpleNamespace(num_workers="auto", pool="tpu",
+                                    chips_per_trial=2)
+        assert resolve_num_workers(cfg) == n // 2
+        cfg = types.SimpleNamespace(num_workers=3, pool="thread")
+        assert resolve_num_workers(cfg) == 3
+        cfg = types.SimpleNamespace(num_workers="auto", pool="remote")
+        with pytest.raises(ValueError, match="auto"):
+            resolve_num_workers(cfg)
+
+    def test_bad_string_rejected_at_config(self):
+        with pytest.raises(ValueError, match="auto"):
+            OptimizationConfig(name="x", searchspace=space(),
+                               num_workers="all")
+
+
 def train_printing(lr, units):
     """No reporter arg at all: print() is the only channel — exactly the
     reference-style user code ship_prints exists for."""
