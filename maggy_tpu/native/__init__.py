@@ -69,6 +69,13 @@ def get_lib():
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
             ctypes.c_size_t, ctypes.c_size_t]
         lib.maggy_frame_scan.restype = ctypes.c_long
+        lib.maggy_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.maggy_crc32c.restype = ctypes.c_uint32
+        lib.maggy_tfrecord_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_long, ctypes.c_int]
+        lib.maggy_tfrecord_scan.restype = ctypes.c_long
         _lib = lib
         return _lib
 
@@ -108,6 +115,37 @@ def frame_scan(buf, key: bytes, max_frame: int) -> int:
     if not _py_hmac.compare_digest(mac, bytes(buf[4:header])):
         return -2
     return header + length
+
+
+def crc32c(data: bytes):
+    """Native crc32c (Castagnoli), or None when in fallback mode — the
+    caller (maggy_tpu.train.tfrecord) owns the pure-Python table."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    return int(lib.maggy_crc32c(data, len(data)))
+
+
+def tfrecord_scan(data: bytes, verify: bool = True):
+    """Offsets/lengths of every record payload in a TFRecord buffer, crc
+    verified natively. Returns a list of (offset, length), or None in
+    fallback mode. Raises ValueError on truncation/corruption."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    # One entry per 16 bytes is a safe upper bound (min record = 16 bytes).
+    cap = max(1, len(data) // 16)
+    offs = (ctypes.c_int64 * cap)()
+    lens = (ctypes.c_int64 * cap)()
+    n = int(lib.maggy_tfrecord_scan(data, len(data), offs, lens, cap,
+                                    1 if verify else 0))
+    if n == -1:
+        raise ValueError("Truncated TFRecord buffer")
+    if n == -2:
+        raise ValueError("Corrupt TFRecord crc")
+    if n < 0:
+        raise ValueError("TFRecord scan failed ({})".format(n))
+    return [(offs[i], lens[i]) for i in range(n)]
 
 
 def is_native() -> bool:

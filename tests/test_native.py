@@ -82,3 +82,57 @@ class TestNativeCodec:
             result = native.frame_scan(buf, secret, 1 << 20)
             assert isinstance(result, int)
             assert result <= len(buf)
+
+
+class TestNativeTfrecord:
+    def test_crc32c_matches_python_table(self):
+        from maggy_tpu import native
+        from maggy_tpu.train.tfrecord import _CRC32C_TABLE
+
+        if not native.is_native():
+            pytest.skip("no toolchain")
+
+        def py_crc(data):
+            crc = 0xFFFFFFFF
+            for b in data:
+                crc = (crc >> 8) ^ _CRC32C_TABLE[(crc ^ b) & 0xFF]
+            return crc ^ 0xFFFFFFFF
+
+        import os as _os
+
+        for n in (0, 1, 7, 8, 9, 63, 64, 65, 1024):
+            data = _os.urandom(n)
+            assert native.crc32c(data) == py_crc(data), n
+        # RFC 3720 vector.
+        assert native.crc32c(b"123456789") == 0xE3069283
+
+    def test_scan_matches_writer(self, tmp_path):
+        from maggy_tpu import native
+        from maggy_tpu.train.tfrecord import encode_example, write_tfrecord
+
+        if not native.is_native():
+            pytest.skip("no toolchain")
+        path = str(tmp_path / "d.tfrecord")
+        examples = [{"x": float(i), "n": i} for i in range(20)]
+        write_tfrecord(path, examples)
+        data = open(path, "rb").read()
+        spans = native.tfrecord_scan(data)
+        assert len(spans) == 20
+        assert data[spans[3][0]:spans[3][0] + spans[3][1]] == \
+            encode_example(examples[3])
+
+    def test_scan_detects_corruption_and_truncation(self, tmp_path):
+        from maggy_tpu import native
+        from maggy_tpu.train.tfrecord import write_tfrecord
+
+        if not native.is_native():
+            pytest.skip("no toolchain")
+        path = str(tmp_path / "d.tfrecord")
+        write_tfrecord(path, [{"x": 1}])
+        data = bytearray(open(path, "rb").read())
+        data[-6] ^= 0xFF
+        with pytest.raises(ValueError, match="crc"):
+            native.tfrecord_scan(bytes(data))
+        good = bytes(open(path, "rb").read())
+        with pytest.raises(ValueError, match="Truncated"):
+            native.tfrecord_scan(good[:-3])
