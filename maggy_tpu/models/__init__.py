@@ -12,6 +12,7 @@ from maggy_tpu.models.resnet import ResNet
 from maggy_tpu.models.bert import BertEncoder, BertConfig
 from maggy_tpu.models.llama import Llama, LlamaConfig
 from maggy_tpu.models.moe import MoEMLP
+from maggy_tpu.models.vit import ViT, ViTConfig
 
 __all__ = ["MnistCNN", "ResNet", "BertEncoder", "BertConfig", "Llama",
-           "LlamaConfig", "MoEMLP"]
+           "LlamaConfig", "MoEMLP", "ViT", "ViTConfig"]

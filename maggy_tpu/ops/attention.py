@@ -563,6 +563,24 @@ def _flash_disabled() -> bool:
     return os.environ.get("MAGGY_TPU_NO_FLASH") == "1"
 
 
+def resolve_seq_parallel_impl(seq_len: int, head_dim: int, impl: str,
+                              interpret: bool, what: str) -> str:
+    """Shared flash/xla dispatch for the sequence-parallel wrappers (ring
+    attention's inner blocks, Ulysses' full-sequence kernel): one policy so
+    the two entry points cannot drift. ``seq_len`` is whatever length the
+    kernel actually sees (the ring's shard, Ulysses' gathered S)."""
+    flash_ok = seq_len % 128 == 0 and head_dim >= 64 and head_dim % 8 == 0
+    if impl == "auto":
+        impl = "flash" if flash_ok and not _flash_disabled() \
+            and (interpret or (_tpu_backend() and _flash_compiles())) \
+            else "xla"
+    if impl == "flash" and not flash_ok:
+        raise ValueError(
+            "impl='flash' needs {} divisible by 128 and D>=64 with D%8==0; "
+            "got {}, D={}".format(what, seq_len, head_dim))
+    return impl
+
+
 _FLASH_PROBE: Optional[bool] = None
 
 

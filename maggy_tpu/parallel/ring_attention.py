@@ -233,13 +233,6 @@ _ring_flash_shard.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
 # ------------------------------------------------------------------- public
 
 
-def _tpu_backend() -> bool:
-    try:
-        return jax.default_backend() in ("tpu", "axon")
-    except Exception:  # noqa: BLE001
-        return False
-
-
 def ring_attention(q, k, v, mesh, axis_name: str = "seq",
                    causal: bool = True, impl: str = "auto",
                    interpret: bool = False):
@@ -262,18 +255,10 @@ def ring_attention(q, k, v, mesh, axis_name: str = "seq",
                          .format(S, n, axis_name))
     if H % Hkv:
         raise ValueError("H={} not divisible by Hkv={}".format(H, Hkv))
-    from maggy_tpu.ops.attention import _flash_compiles, _flash_disabled
+    from maggy_tpu.ops.attention import resolve_seq_parallel_impl
 
     shard = S // n
-    flash_ok = shard % 128 == 0 and D >= 64 and D % 8 == 0
-    if impl == "auto":
-        impl = "flash" if flash_ok and not _flash_disabled() \
-            and (interpret or (_tpu_backend() and _flash_compiles())) \
-            else "xla"
-    if impl == "flash" and not flash_ok:
-        raise ValueError(
-            "impl='flash' needs S/n divisible by 128 and D>=64 with D%8==0; "
-            "got shard={}, D={}".format(shard, D))
+    impl = resolve_seq_parallel_impl(shard, D, impl, interpret, "S/n")
 
     qspec = P(None, axis_name, None, None)
     if impl == "flash":

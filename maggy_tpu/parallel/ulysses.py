@@ -43,9 +43,8 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "seq",
     """
     from jax.sharding import PartitionSpec as P
 
-    from maggy_tpu.ops.attention import (_flash_compiles, _flash_disabled,
-                                         _tpu_backend, attention_reference,
-                                         flash_attention)
+    from maggy_tpu.ops.attention import (attention_reference, flash_attention,
+                                         resolve_seq_parallel_impl)
 
     n = mesh.shape[axis_name]
     B, S, H, D = q.shape
@@ -61,18 +60,9 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "seq",
             "degree ({}); use ring_attention for more shards than heads."
             .format(Hkv, axis_name, n))
 
-    # Same dispatch idiom as ring_attention.py: the kernel sees the FULL
-    # gathered sequence, so global S (not the shard) must tile.
-    flash_ok = S % 128 == 0 and D >= 64 and D % 8 == 0
-    if impl == "auto":
-        impl = "flash" if flash_ok and not _flash_disabled() \
-            and (interpret or (_tpu_backend() and _flash_compiles())) \
-            else "xla"
-    if impl == "flash" and not flash_ok:
-        raise ValueError(
-            "impl='flash' needs S divisible by 128 and D>=64 with D%8==0; "
-            "got S={}, D={}".format(S, D))
-    use_flash = impl == "flash"
+    # Shared dispatch policy with ring_attention — here the kernel sees the
+    # FULL gathered sequence, so global S (not the shard) must tile.
+    use_flash = resolve_seq_parallel_impl(S, D, impl, interpret, "S") == "flash"
 
     def local_fn(q_l, k_l, v_l):
         # [B, S/n, H, D] -> all_to_all splits heads n ways and gathers the

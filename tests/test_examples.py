@@ -37,6 +37,7 @@ def run_example(tmp_path, name, *args, timeout=150):
     ("resnet_cifar_asha.py", ("--trials", "2", "--resource-max", "1",
                               "--workers", "2")),
     ("titanic_ablation.py", ()),
+    ("vit_cifar_hpo.py", ("--trials", "2")),
     ("distributed_training.py", ()),
     ("pbt_sweep.py", ("--population", "2", "--generations", "2",
                       "--workers", "2")),

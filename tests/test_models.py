@@ -152,6 +152,48 @@ class TestModelsForward:
         assert out.shape == (2, 3)
         assert out.dtype == jnp.float32
 
+    def test_vit_tiny(self):
+        from maggy_tpu.models import ViT, ViTConfig
+
+        cfg = ViTConfig.tiny(num_classes=5)
+        model = ViT(cfg)
+        images = jnp.ones((2, 32, 32, 3), jnp.float32)
+        variables = model.init(jax.random.key(0), images)
+        out = model.apply(variables, images)
+        assert out.shape == (2, 5)
+        assert out.dtype == jnp.float32
+
+    def test_vit_trains(self):
+        import numpy as _np
+        import optax
+
+        from maggy_tpu.models import ViT, ViTConfig
+        from maggy_tpu.parallel import make_mesh
+        from maggy_tpu.train import Trainer, cross_entropy_loss
+
+        cfg = ViTConfig.tiny(num_classes=2)
+        rng = _np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(8, 32, 32, 3)), jnp.float32)
+        y = jnp.asarray((rng.normal(size=8) > 0).astype(_np.int32))
+        mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+        trainer = Trainer(
+            ViT(cfg), optax.adam(1e-3),
+            lambda logits, batch: cross_entropy_loss(logits, batch["labels"]),
+            mesh, strategy="dp")
+        trainer.init(jax.random.key(0), (x[:1],))
+        batch = trainer.place_batch({"inputs": (x,), "labels": y})
+        losses = [float(trainer.step(batch)) for _ in range(10)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_vit_wrong_image_size_raises(self):
+        from maggy_tpu.models import ViT, ViTConfig
+
+        cfg = ViTConfig.tiny()
+        model = ViT(cfg)
+        with pytest.raises(ValueError, match="32x32"):
+            model.init(jax.random.key(0), jnp.ones((1, 16, 16, 3)))
+
     def test_llama_tiny_forward(self):
         cfg = LlamaConfig.tiny()
         model = Llama(cfg)
