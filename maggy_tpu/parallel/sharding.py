@@ -93,11 +93,15 @@ def logical_axis_rules(strategy: str = "dp"):
     - sp:   no param sharding; activations' sequence dim shards via
             batch_sharding + ring attention over "seq"
     - ep:   MoE expert dim sharded over "expert"
+    - zero: no param rules here; OPTIMIZER STATE shards over "data"
+            (ZeRO-1 / cross-replica weight-update sharding,
+            arXiv:2004.13336) — applied by the Trainer, see
+            `zero_opt_sharding`
     """
     rules = {"embed": None, "mlp": None, "heads": None, "kv": None,
              "vocab": None, "expert": None}
     parts = set(strategy.split("_"))
-    unknown = parts - {"dp", "fsdp", "tp", "sp", "ep"}
+    unknown = parts - {"dp", "fsdp", "tp", "sp", "ep", "zero"}
     if unknown:
         raise ValueError("Unknown strategy {!r} (bad parts: {})"
                          .format(strategy, sorted(unknown)))
@@ -134,3 +138,63 @@ def batch_sharding(mesh, ndim: int = 2, shape=None):
             shape is None or shape[1] % mesh.shape["seq"] == 0):
         rest[0] = "seq"
     return NamedSharding(mesh, P(data_axes if data_axes else None, *rest))
+
+
+def validate_zero_strategy(mesh, strategy: str) -> bool:
+    """True iff the "zero" part is active; raises on configurations where
+    it would silently do the wrong thing instead of degrading quietly."""
+    parts = set(strategy.split("_"))
+    if "zero" not in parts:
+        return False
+    overlapping = parts & {"fsdp", "tp", "ep"}
+    if overlapping:
+        raise ValueError(
+            "strategy part 'zero' composes with dp/sp only (got {!r}): "
+            "fsdp already de-duplicates moments (ZeRO-3), and forcing the "
+            "data-axis layout would clobber tp/ep moment sharding.".format(
+                strategy))
+    if "data" not in mesh.axis_names:
+        raise ValueError(
+            "strategy part 'zero' needs a 'data' mesh axis to shard the "
+            "optimizer state over; mesh has {}".format(mesh.axis_names))
+    return True
+
+
+def zero_opt_sharding(mesh, strategy: str, shape):
+    """NamedSharding for ONE optimizer-state leaf under the "zero" strategy
+    part (ZeRO-1 / automatic cross-replica sharding of the weight update,
+    arXiv:2004.13336): the leaf's leading dim shards over "data" when it
+    divides evenly; scalars and indivisible leaves stay replicated. Params
+    stay replicated at init — only the redundant optimizer moments (2x
+    params for Adam) are de-duplicated across data replicas; XLA turns the
+    update into reduce-scatter -> sharded update -> all-gather. Returns
+    None when the strategy has no "zero" part.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if not validate_zero_strategy(mesh, strategy):
+        return None
+    n = mesh.shape["data"]
+    shape = tuple(shape)
+    if len(shape) >= 1 and shape[0] > 0 and shape[0] % n == 0:
+        return NamedSharding(mesh, P("data", *([None] * (len(shape) - 1))))
+    return NamedSharding(mesh, P())
+
+
+def apply_zero_sharding(tree, mesh, strategy: str, placer):
+    """Map every optimizer-state leaf through ``placer(leaf, sharding)``
+    under the "zero" layout — the ONE place init-time placement
+    (device_put) and step-time constraints (with_sharding_constraint)
+    share, so they cannot drift. No-op without a "zero" part."""
+    import jax
+    import jax.numpy as jnp
+
+    if not validate_zero_strategy(mesh, strategy):
+        return tree
+
+    def place(x):
+        sh = zero_opt_sharding(mesh, strategy, jnp.shape(x))
+        return placer(x, sh)
+
+    return jax.tree_util.tree_map(place, tree)

@@ -102,6 +102,11 @@ def init_train_state(
     with mesh:
         params = init_jit(rng)
         opt_state = tx.init(params["params"] if "params" in params else params)
+    from maggy_tpu.parallel.sharding import apply_zero_sharding
+
+    opt_state = apply_zero_sharding(
+        opt_state, mesh, strategy,
+        lambda x, sh: jax.device_put(x, sh) if hasattr(x, "shape") else x)
     return params, opt_state, shardings
 
 
@@ -113,13 +118,20 @@ def make_train_step(
     donate: bool = True,
     has_aux_collections: bool = False,
     train_kwargs: Optional[Dict[str, Any]] = None,
+    strategy: str = "dp",
 ):
     """Build the jitted SPMD train step.
 
     step(variables, opt_state, batch) -> (variables, opt_state, loss).
     ``loss_fn(logits_or_outputs, batch)`` computes the scalar loss; gradient
-    all-reduce/reduce-scatter over the mesh comes from GSPMD.
+    all-reduce/reduce-scatter over the mesh comes from GSPMD. With a
+    "zero" strategy part, the updated optimizer state is constrained to
+    its data-axis sharding so XLA keeps the moments de-duplicated across
+    replicas (shapes are static at trace time, so the constraint costs
+    nothing when already satisfied).
     """
+    from maggy_tpu.parallel.sharding import apply_zero_sharding
+
     train_kwargs = train_kwargs or {}
 
     def step(variables, opt_state, batch):
@@ -144,6 +156,9 @@ def make_train_step(
         import optax
 
         params = optax.apply_updates(params, updates)
+        opt_state = apply_zero_sharding(
+            opt_state, mesh, strategy,
+            lambda x, sh: jax.lax.with_sharding_constraint(x, sh))
         return {"params": params, **new_aux} if has_aux_collections else \
             {"params": params, **aux}, opt_state, loss
 
@@ -211,7 +226,7 @@ class Trainer:
         build = functools.partial(
             make_train_step, model, tx, loss_fn, mesh,
             train_kwargs=train_kwargs,
-            has_aux_collections=has_aux_collections)
+            has_aux_collections=has_aux_collections, strategy=strategy)
         self._step_key = step_key
         self._step_shared = step_key is not None
         if step_key is not None:

@@ -90,6 +90,87 @@ class TestSequenceParallel:
             Attention(cfg).apply(variables, x, positions, mask)
 
 
+class TestZeroOptimizerSharding:
+    """ZeRO-1 weight-update sharding (strategy part "zero"): optimizer
+    moments shard over "data"; training math unchanged vs plain dp."""
+
+    def _trainer(self, strategy, mesh):
+        import flax.linen as nn
+
+        from maggy_tpu.train import Trainer, cross_entropy_loss
+
+        class MLP(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.Dense(64)(x)
+                x = nn.relu(x)
+                return nn.Dense(2)(x)
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(16, 32)), jnp.float32)
+        y = jnp.asarray(rng.integers(0, 2, size=16), jnp.int32)
+        tr = Trainer(MLP(), optax.adam(1e-2),
+                     lambda logits, batch: cross_entropy_loss(
+                         logits, batch["labels"]),
+                     mesh, strategy=strategy)
+        tr.init(jax.random.key(0), (x[:1],))
+        return tr, tr.place_batch({"inputs": (x,), "labels": y})
+
+    def test_matches_dp_and_shards_moments(self):
+        mesh = make_mesh({"data": 8})
+        tr_dp, batch_dp = self._trainer("dp", mesh)
+        tr_z, batch_z = self._trainer("dp_zero", mesh)
+        for _ in range(3):
+            loss_dp = float(tr_dp.step(batch_dp))
+            loss_z = float(tr_z.step(batch_z))
+            assert abs(loss_dp - loss_z) < 1e-5 * (1 + abs(loss_dp))
+        # Moments with a divisible leading dim are actually sharded over
+        # "data" — one shard holds 1/8 of the rows.
+        sharded = [
+            leaf for leaf in jax.tree_util.tree_leaves(tr_z.opt_state)
+            if hasattr(leaf, "sharding") and np.ndim(leaf) >= 1
+            and np.shape(leaf)[0] % 8 == 0
+            and leaf.sharding.spec and leaf.sharding.spec[0] == "data"]
+        assert sharded, "no optimizer-state leaf sharded over data"
+        leaf = next(l for l in sharded if np.ndim(l) == 2)
+        assert leaf.addressable_shards[0].data.shape[0] == \
+            np.shape(leaf)[0] // 8
+        # Params layout is compiler-chosen: GSPMD propagates the moment
+        # sharding into the updated params (sharded at rest, all-gathered
+        # on use — the paper's own weight-update design), so no
+        # replication assertion here; the loss-equality loop above is the
+        # semantic contract.
+
+    def test_indivisible_and_scalar_leaves_replicated(self):
+        from maggy_tpu.parallel.sharding import zero_opt_sharding
+
+        mesh = make_mesh({"data": 8})
+        assert zero_opt_sharding(mesh, "dp", (64,)) is None
+        sh = zero_opt_sharding(mesh, "dp_zero", ())
+        assert tuple(sh.spec) == ()
+        sh = zero_opt_sharding(mesh, "dp_zero", (3, 64))
+        assert tuple(sh.spec) == ()
+        sh = zero_opt_sharding(mesh, "dp_zero", (64, 3))
+        assert sh.spec[0] == "data"
+
+    def test_bad_compositions_raise(self):
+        """'zero' must fail loudly where it cannot do what it promises:
+        fsdp/tp/ep moment layouts would be clobbered, and a mesh without
+        a 'data' axis leaves nothing to shard over."""
+        from maggy_tpu.parallel.sharding import validate_zero_strategy
+
+        mesh = make_mesh({"data": 8})
+        with pytest.raises(ValueError, match="composes with dp/sp"):
+            validate_zero_strategy(mesh, "fsdp_zero")
+        with pytest.raises(ValueError, match="composes with dp/sp"):
+            validate_zero_strategy(mesh, "tp_zero")
+        mesh_nodata = make_mesh({"fsdp": 8})
+        with pytest.raises(ValueError, match="'data' mesh axis"):
+            validate_zero_strategy(mesh_nodata, "dp_zero")
+        assert validate_zero_strategy(mesh, "dp") is False
+        assert validate_zero_strategy(mesh, "dp_zero") is True
+
+
 class TestPipelineParallel:
     def test_pipeline_matches_sequential(self):
         mesh = make_mesh({"pipe": 8})
