@@ -63,6 +63,15 @@ class OptimizationConfig(LagomConfig):
     # Per-trial device assignment: how many TPU chips each trial gets
     # (used by pool="tpu").
     chips_per_trial: int = 1
+    # Elastic sub-slice sizing (pool="elastic"): budget -> chips. A
+    # promoted ASHA/Hyperband trial at a larger budget gets a larger chip
+    # sub-slice; runners exit and respawn re-pinned when their capacity
+    # doesn't match the next trial's requirement (SURVEY §7.3's
+    # slice-repartitioning problem). Budgets missing from the map use
+    # chips_per_trial.
+    chips_per_budget: Optional[Dict[Any, int]] = None
+    # Total chips the elastic pool may lease (None -> probe the host).
+    total_chips: Optional[int] = None
     # Capture a jax.profiler trace per trial into its TensorBoard dir.
     profile: bool = False
     # Tee the user train_fn's print() calls into the reporter log channel,
@@ -85,8 +94,14 @@ class OptimizationConfig(LagomConfig):
     def __post_init__(self):
         if self.direction not in ("max", "min"):
             raise ValueError("direction must be 'max' or 'min', got {!r}".format(self.direction))
-        if self.pool not in ("thread", "process", "tpu", "remote"):
-            raise ValueError("pool must be 'thread', 'process', 'tpu', or 'remote'")
+        if self.pool not in ("thread", "process", "tpu", "elastic", "remote"):
+            raise ValueError(
+                "pool must be 'thread', 'process', 'tpu', 'elastic', or "
+                "'remote'")
+        if self.chips_per_budget is not None and self.pool != "elastic":
+            raise ValueError(
+                "chips_per_budget needs pool='elastic' (budget-sized chip "
+                "sub-slices require respawnable pinned workers)")
         if isinstance(self.num_workers, str) and self.num_workers != "auto":
             raise ValueError(
                 "num_workers must be an int or 'auto', got {!r}".format(

@@ -85,6 +85,15 @@ class OptimizationDriver(Driver):
         # Trials orphaned by a lost runner, waiting for reassignment. Served
         # by _assign_next ahead of fresh controller suggestions.
         self._requeue: List[str] = []
+        # Trials parked for a runner of the RIGHT chip capacity (elastic
+        # pools): the schedule already committed to them, but the runner
+        # that triggered the suggestion is pinned to a different size.
+        self._parked: List[str] = []
+        self._chips_map = getattr(config, "chips_per_budget", None)
+        # Outstanding resize requests by target size: bounds the idle-runner
+        # migration so a herd of idle runners doesn't all chase one parked
+        # trial's size (decremented when a runner REGisters at that size).
+        self._resize_inflight: Dict[int, int] = {}
         # Arm heartbeat-loss detection (SURVEY.md §5.3): a silent runner's
         # trial is requeued to whichever runner asks for work next.
         self.server.hb_loss_timeout = getattr(config, "hb_loss_timeout", None) or max(
@@ -174,6 +183,23 @@ class OptimizationDriver(Driver):
         if pool == "tpu":
             return TPURunnerPool(self.num_executors,
                                  chips_per_trial=self.config.chips_per_trial)
+        if pool == "elastic":
+            from maggy_tpu.core.runner_pool import (ElasticTPURunnerPool,
+                                                    _probe_local_devices)
+
+            total = getattr(self.config, "total_chips", None)
+            if total is None:
+                total = _probe_local_devices()[0]
+            if self._chips_map:
+                worst = max(self._chips_map.values())
+                if worst > total:
+                    raise ValueError(
+                        "chips_per_budget asks for {} chips but only {} "
+                        "are available to lease".format(worst, total))
+            return ElasticTPURunnerPool(
+                self.num_executors, total_chips=total,
+                chips_per_trial=self.config.chips_per_trial,
+                should_stop=lambda: self.experiment_done)
         if pool == "remote":
             from maggy_tpu.core.runner_pool import RemoteRunnerPool
 
@@ -331,11 +357,81 @@ class OptimizationDriver(Driver):
             self._log("runner {} killed after heartbeat loss (presumed "
                       "wedged)".format(msg["partition_id"]))
 
-    def _pop_requeue(self) -> Optional[Trial]:
+    def _chips_for(self, trial: Trial) -> Optional[int]:
+        """Chip requirement of a trial under chips_per_budget (None when
+        elastic sizing is off)."""
+        if self._chips_map is None:
+            return None
+        budget = trial.params.get("budget", trial.info_dict.get("budget"))
+        return int(self._chips_map.get(
+            budget, getattr(self.config, "chips_per_trial", 1)))
+
+    def _maybe_migrate(self, partition_id: int, cap: int) -> bool:
+        """Resize or retire an idle elastic runner when waiting work needs
+        sizes its capacity cannot serve. Returns True if the runner was
+        told to leave (caller must not re-arm its idle chain)."""
         with self._store_lock:
-            while self._requeue:
-                trial = self._trial_store.get(self._requeue.pop(0))
-                if trial is not None:
+            waiting = [self._chips_for(self._trial_store[tid])
+                       for tid in self._parked + self._requeue
+                       if tid in self._trial_store]
+            demand: Dict[int, int] = {}
+            for n in waiting:
+                if n is not None:
+                    demand[n] = demand.get(n, 0) + 1
+        if not demand or cap in demand:
+            # Nothing waiting, or this runner's size IS in demand (a
+            # matching trial will reach it via _pop_parked/_pop_requeue).
+            return False
+        live = self.server.reservations.capacities()
+        with self._store_lock:
+            for size in sorted(demand, reverse=True):
+                supply = live.get(size, 0) + self._resize_inflight.get(size, 0)
+                if demand[size] > supply:
+                    self._resize_inflight[size] = \
+                        self._resize_inflight.get(size, 0) + 1
+                    self.server.reservations.request_resize(partition_id, size)
+                    self._log("idle runner {} (capacity {}) resized toward "
+                              "waiting work ({} chips)".format(
+                                  partition_id, cap, size))
+                    return True
+        # Demand covered: this runner's size serves nothing that remains —
+        # retire it so its chips free up for the pending spawns. Never
+        # retire the LAST live runner: a fully retired pool has nobody
+        # left to poll for work if a spawn fails.
+        if sum(live.values()) <= 1:
+            return False
+        self.server.reservations.request_resize(partition_id, 0)
+        self._log("idle runner {} (capacity {}) retired; chips released "
+                  "for pending resizes".format(partition_id, cap))
+        return True
+
+    def _pop_parked(self, capacity: Optional[int]) -> Optional[Trial]:
+        """First parked trial this runner's capacity can serve (None
+        capacity = non-elastic runner, matches anything)."""
+        with self._store_lock:
+            for i, tid in enumerate(self._parked):
+                trial = self._trial_store.get(tid)
+                if trial is None:
+                    continue
+                need = self._chips_for(trial)
+                if capacity is None or need is None or need == capacity:
+                    del self._parked[i]
+                    return trial
+        return None
+
+    def _pop_requeue(self, capacity: Optional[int] = None) -> Optional[Trial]:
+        """Next orphaned trial this runner can serve. Elastic pools match
+        chip requirements here too — a budget-9 trial orphaned by a dead
+        2-chip runner must NOT land on a 1-chip runner."""
+        with self._store_lock:
+            for i, tid in enumerate(list(self._requeue)):
+                trial = self._trial_store.get(tid)
+                if trial is None:
+                    self._requeue.remove(tid)
+                    continue
+                need = self._chips_for(trial)
+                if capacity is None or need is None or need == capacity:
+                    self._requeue.remove(tid)
                     return trial
         return None
 
@@ -376,6 +472,13 @@ class OptimizationDriver(Driver):
         self._assign_next(msg["partition_id"], trial)
 
     def _register_msg_callback(self, msg) -> None:
+        # A respawned elastic runner arriving at its new size satisfies one
+        # outstanding resize request toward that capacity.
+        cap = msg.get("capacity")
+        if cap is not None:
+            with self._store_lock:
+                if self._resize_inflight.get(cap, 0) > 0:
+                    self._resize_inflight[cap] -= 1
         self._assign_next(msg["partition_id"], None)
 
     def _idle_msg_callback(self, msg) -> None:
@@ -447,12 +550,31 @@ class OptimizationDriver(Driver):
                 self._rearm_idle(partition_id)
             return
         if suggestion in (None, "IDLE"):
-            requeued = self._pop_requeue()
+            cap = self.server.reservations.capacity(partition_id)
+            parked = self._pop_parked(cap)
+            if parked is not None:
+                parked.set_status(Trial.SCHEDULED)
+                self.server.reservations.assign_trial(partition_id, parked.trial_id)
+                return
+            requeued = self._pop_requeue(cap)
             if requeued is not None:
                 self.server.reservations.assign_trial(partition_id, requeued.trial_id)
                 return
             if last_trial is None:
                 suggestion = self.controller.get_suggestion(None)
+            # Only when the controller ALSO has nothing fresh: an idle
+            # elastic runner whose size fits no waiting trial migrates
+            # toward the waiting work — otherwise its chips stay leased to
+            # a size the schedule no longer needs and the pool deadlocks.
+            # Demand/supply-bounded so a herd of idle runners doesn't all
+            # chase one trial; runners beyond the demand are RETIRED
+            # (resize 0), freeing chips for pending bigger spawns. The
+            # worker COUNT never grows back after retirement (chips
+            # re-aggregate, they don't re-split), which is the honest
+            # trade for a push-free pool protocol.
+            if suggestion in (None, "IDLE") and cap is not None \
+                    and self._maybe_migrate(partition_id, cap):
+                return
         if suggestion is None:
             # The controller has no more work — but the experiment is only
             # over once nothing is in flight: a trial held by a (possibly
@@ -493,6 +615,25 @@ class OptimizationDriver(Driver):
             # new run to a bracket slot) — persist so resume=True can pick
             # the bracket up mid-flight.
             self._checkpoint_pruner()
+            # Elastic sub-slices: a trial whose budget calls for a different
+            # chip count than this runner is pinned to gets PARKED, and the
+            # runner is told to exit + respawn at the right size (pinning
+            # happens before backend init; it cannot resize in place).
+            need = self._chips_for(suggestion)
+            cap = self.server.reservations.capacity(partition_id)
+            if need is not None and cap is not None and need != cap:
+                with self._store_lock:
+                    self._parked.append(suggestion.trial_id)
+                    # Count toward the herd bound: this runner is already
+                    # on its way to ``need``, so idle runners must not
+                    # also chase the same trial.
+                    self._resize_inflight[need] = \
+                        self._resize_inflight.get(need, 0) + 1
+                self.server.reservations.request_resize(partition_id, need)
+                self._log("trial {} needs {} chip(s); runner {} (capacity "
+                          "{}) asked to resize".format(
+                              suggestion.trial_id, need, partition_id, cap))
+                return
             suggestion.set_status(Trial.SCHEDULED)
             self.server.reservations.assign_trial(partition_id, suggestion.trial_id)
 

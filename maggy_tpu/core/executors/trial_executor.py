@@ -114,7 +114,8 @@ class TrialExecutor:
         client = Client(self.server_addr, partition_id, task_attempt,
                         self.hb_interval, self.secret)
         try:
-            client.register()
+            capacity = os.environ.get("MAGGY_TPU_CAPACITY")
+            client.register(capacity=int(capacity) if capacity else None)
             client.start_heartbeat(reporter)
             sig_params = inspect.signature(self.train_fn).parameters
             wants_reporter = "reporter" in sig_params
@@ -123,6 +124,21 @@ class TrialExecutor:
             while not client.done:
                 trial_id, params = client.get_suggestion()
                 if trial_id is None:
+                    break
+                from maggy_tpu.core.rpc import RESIZE
+
+                if trial_id == RESIZE:
+                    # Elastic pool: exit so the dispatcher respawns this
+                    # partition pinned to params["chips"] chips (the pin
+                    # must precede backend init — no in-place resize).
+                    resize_file = os.environ.get("MAGGY_TPU_RESIZE_FILE")
+                    if resize_file:
+                        import json as _json
+
+                        with open(resize_file, "w") as f:
+                            _json.dump({"chips": params["chips"]}, f)
+                    reporter.log("resizing to {} chip(s); runner exiting "
+                                 "for respawn".format(params["chips"]))
                     break
                 trial_dir = "{}/{}".format(exp_dir, trial_id)
                 env.mkdir(trial_dir)

@@ -42,6 +42,10 @@ from maggy_tpu.trial import Trial
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
 
+# Sentinel trial id returned by Client.get_suggestion when the driver asks
+# this runner to exit and respawn pinned to a different chip count.
+RESIZE = "__resize__"
+
 
 # --------------------------------------------------------------------- wire
 
@@ -151,6 +155,37 @@ class Reservations:
         with self.lock:
             rec = self._table.get(int(partition_id))
             return dict(rec) if rec else None
+
+    def capacity(self, partition_id: int) -> Optional[int]:
+        """The runner's advertised chip capacity (None = not elastic)."""
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            return rec.get("capacity") if rec else None
+
+    def capacities(self) -> Dict[int, int]:
+        """Count of live (registered, unreleased) runners by capacity."""
+        with self.lock:
+            out: Dict[int, int] = {}
+            for rec in self._table.values():
+                cap = rec.get("capacity")
+                if cap is not None and not rec.get("released"):
+                    out[cap] = out.get(cap, 0) + 1
+            return out
+
+    def request_resize(self, partition_id: int, chips: int) -> None:
+        """Ask a runner to exit and respawn pinned to ``chips`` chips (the
+        elastic pool does the respawn). Delivered on its next GET."""
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            if rec is not None:
+                rec["resize"] = int(chips)
+
+    def pop_resize(self, partition_id: int) -> Optional[int]:
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            if rec is None:
+                return None
+            return rec.pop("resize", None)
 
     def done(self) -> bool:
         with self.lock:
@@ -466,14 +501,17 @@ class OptimizationServer(Server):
         prev = self.reservations.get_assigned_trial(msg["partition_id"])
         self.reservations.add(
             {"partition_id": msg["partition_id"], "host_port": msg.get("host_port"),
-             "task_attempt": msg.get("task_attempt", 0), "trial_id": prev}
+             "task_attempt": msg.get("task_attempt", 0), "trial_id": prev,
+             "capacity": msg.get("capacity")}
         )
         if prev is not None:
             self.driver.enqueue({"type": "BLACK", "trial_id": prev,
                                  "partition_id": msg["partition_id"]})
         else:
             # First registration: ask the driver worker for a first assignment.
-            self.driver.enqueue({"type": "REG", "partition_id": msg["partition_id"]})
+            self.driver.enqueue({"type": "REG",
+                                 "partition_id": msg["partition_id"],
+                                 "capacity": msg.get("capacity")})
         return {"type": "OK"}
 
     def _metric(self, msg):
@@ -502,6 +540,13 @@ class OptimizationServer(Server):
             if self.driver.experiment_done:
                 self.reservations.mark_released(msg["partition_id"])
                 return {"type": "GSTOP"}
+            resize = self.reservations.pop_resize(msg["partition_id"])
+            if resize is not None:
+                # The runner exits and its pool respawns it pinned to
+                # ``chips`` chips; released here so liveness checks ignore
+                # the gap until it re-registers.
+                self.reservations.mark_released(msg["partition_id"])
+                return {"type": "RESIZE", "chips": resize}
             return {"type": "OK", "trial_id": None}
         trial = self.driver.get_trial(trial_id)
         if trial is None:
@@ -663,8 +708,14 @@ class Client:
 
     # ----------------------------------------------------------------- calls
 
-    def register(self, host_port: Optional[str] = None) -> None:
-        self._request({"type": "REG", "host_port": host_port})
+    def register(self, host_port: Optional[str] = None,
+                 capacity: Optional[int] = None) -> None:
+        """``capacity``: chips this runner is pinned to (elastic pools);
+        None for non-elastic runners."""
+        msg = {"type": "REG", "host_port": host_port}
+        if capacity is not None:
+            msg["capacity"] = int(capacity)
+        self._request(msg)
 
     def await_reservations(self, timeout: float = constants.REGISTRATION_TIMEOUT_S) -> None:
         deadline = time.monotonic() + timeout
@@ -732,6 +783,12 @@ class Client:
                 # type) rides along for TrialContext consumers.
                 self.last_info = resp.get("info", {})
                 return resp["trial_id"], resp["params"]
+            if rtype == "RESIZE":
+                # Elastic pools: this process must exit and be respawned
+                # pinned to resp["chips"] chips (pinning happens before
+                # backend init, so it cannot resize in place).
+                self.done = True
+                return RESIZE, {"chips": resp["chips"]}
             if deadline and time.monotonic() > deadline:
                 return None, None
             time.sleep(delay)

@@ -80,7 +80,7 @@ def resolve_num_workers(config) -> int:
         raise ValueError(
             "num_workers='auto' could not probe the device inventory "
             "({!r}); pass an explicit count.".format(e)) from e
-    if pool == "tpu":
+    if pool in ("tpu", "elastic"):
         return max(1, chips // max(1, getattr(config, "chips_per_trial", 1)))
     return max(1, devices)
 
@@ -240,6 +240,137 @@ class TPURunnerPool(ProcessRunnerPool):
             chip_env_fn=lambda i: chip_env(i, chips_per_trial))
         self.chips_per_trial = chips_per_trial
         self.total_chips = total_chips
+
+
+class ElasticTPURunnerPool(RunnerPool):
+    """Budget-sized chip sub-slices: SURVEY §7.3's slice-repartitioning
+    problem. Each runner is an ephemeral pinned process; when the driver
+    decides a runner's capacity no longer matches the schedule's needs
+    (chips_per_budget), the runner exits with a resize request and this
+    dispatcher respawns it pinned to the new chip count — libtpu reads the
+    pinning env before backend init, so resizing is exit+respawn by
+    construction. A chip free-list enforces sum(leases) <= total_chips;
+    respawns wait until enough chips free up (the driver resizes idle
+    runners toward parked work, so chips migrate instead of deadlocking).
+    """
+
+    def __init__(self, num_workers: int, total_chips: int,
+                 chips_per_trial: int = 1, start_method: str = "spawn",
+                 should_stop: Optional[Callable[[], bool]] = None,
+                 resize_dir: Optional[str] = None):
+        super().__init__(num_workers)
+        if num_workers * chips_per_trial > total_chips:
+            raise ValueError(
+                "{} workers x {} chips exceeds the {}-chip lease budget"
+                .format(num_workers, chips_per_trial, total_chips))
+        self.total_chips = total_chips
+        self.chips_per_trial = chips_per_trial
+        self.start_method = start_method
+        self.should_stop = should_stop or (lambda: False)
+        import tempfile
+
+        self.resize_dir = resize_dir or tempfile.mkdtemp(prefix="maggy_resize_")
+        self._procs: dict = {}  # pid -> (process, chips_set)
+        self._free: set = set()
+        self._lock = threading.Lock()
+
+    def _resize_file(self, partition_id: int) -> str:
+        return os.path.join(self.resize_dir, "{}.resize".format(partition_id))
+
+    def _spawn(self, ctx, worker_fn, partition_id: int, chips: set):
+        env = {
+            "TPU_VISIBLE_CHIPS": ",".join(str(c) for c in sorted(chips)),
+            "ALLOW_MULTIPLE_LIBTPU_LOAD": "1",
+            "MAGGY_TPU_CAPACITY": str(len(chips)),
+            "MAGGY_TPU_RESIZE_FILE": self._resize_file(partition_id),
+        }
+        p = ctx.Process(target=_process_entry,
+                        args=(worker_fn, partition_id, env),
+                        name="runner-{}".format(partition_id))
+        p.start()
+        self._procs[partition_id] = (p, chips)
+
+    def kill_worker(self, partition_id: int) -> bool:
+        with self._lock:
+            entry = self._procs.get(partition_id)
+            if entry and entry[0].is_alive():
+                entry[0].kill()
+                return True
+        return False
+
+    def terminate(self) -> None:
+        with self._lock:
+            for p, _ in self._procs.values():
+                if p.is_alive():
+                    p.terminate()
+
+    def run(self, worker_fn: Callable[[int], None]) -> List[BaseException]:
+        import json as _json
+        import time as _time
+
+        ctx = mp.get_context(self.start_method)
+        chip_ids = list(range(self.total_chips))
+        with self._lock:
+            for i in range(self.num_workers):
+                lease = set(chip_ids[i * self.chips_per_trial:
+                                     (i + 1) * self.chips_per_trial])
+                self._spawn(ctx, worker_fn, i, lease)
+            self._free = set(chip_ids[self.num_workers * self.chips_per_trial:])
+        failures: List[BaseException] = []
+        pending: List[tuple] = []  # (partition_id, chips_needed)
+        while True:
+            with self._lock:
+                live = dict(self._procs)
+            exited = [(pid, p, chips) for pid, (p, chips) in live.items()
+                      if not p.is_alive()]
+            for pid, p, chips in exited:
+                p.join()
+                with self._lock:
+                    self._procs.pop(pid, None)
+                    self._free |= chips
+                resize = None
+                rf = self._resize_file(pid)
+                if os.path.exists(rf):
+                    try:
+                        with open(rf) as f:
+                            resize = int(_json.load(f)["chips"])
+                    except (ValueError, KeyError, OSError):
+                        pass
+                    try:
+                        os.unlink(rf)
+                    except OSError:
+                        pass
+                if p.exitcode != 0:
+                    failures.append(RuntimeError(
+                        "Runner process {} died (exit code {})."
+                        .format(p.name, p.exitcode)))
+                elif resize:  # resize 0 = retire: chips freed, no respawn
+                    pending.append((pid, resize))
+            # Serve respawns whose lease fits the free pool.
+            still_pending = []
+            for pid, k in pending:
+                if k > self.total_chips:
+                    failures.append(RuntimeError(
+                        "Runner {} asked for {} chips but the lease budget "
+                        "is {} (check chips_per_budget).".format(
+                            pid, k, self.total_chips)))
+                    continue
+                with self._lock:
+                    if self.should_stop():
+                        continue  # experiment over: drop the respawn
+                    if len(self._free) >= k:
+                        lease = set(sorted(self._free)[:k])
+                        self._free -= lease
+                        self._spawn(ctx, worker_fn, pid, lease)
+                    else:
+                        still_pending.append((pid, k))
+            pending = still_pending
+            with self._lock:
+                alive = any(p.is_alive() for p, _ in self._procs.values())
+            if not alive and (not pending or self.should_stop()):
+                break
+            _time.sleep(0.05)
+        return failures
 
 
 class RemoteRunnerPool(RunnerPool):

@@ -271,6 +271,9 @@ class TestAutoWorkers:
         cfg = types.SimpleNamespace(num_workers="auto", pool="tpu",
                                     chips_per_trial=2)
         assert resolve_num_workers(cfg) == n // 2
+        cfg = types.SimpleNamespace(num_workers="auto", pool="elastic",
+                                    chips_per_trial=2)
+        assert resolve_num_workers(cfg) == n // 2
         cfg = types.SimpleNamespace(num_workers=3, pool="thread")
         assert resolve_num_workers(cfg) == 3
         cfg = types.SimpleNamespace(num_workers="auto", pool="remote")
@@ -368,6 +371,83 @@ class TestVirtualChipPinning:
         # disjoint subsets, both exercised.
         markers = sorted(os.listdir(pin_dir))
         assert markers == ["0", "2"], markers
+
+
+def train_elastic(lr, units, budget=1, reporter=None):
+    """Marks (budget, visible-chip-count) so the test can assert each
+    trial ran on the sub-slice size its budget called for."""
+    chips = os.environ.get("TPU_VISIBLE_CHIPS", "")
+    n = len(chips.split(",")) if chips else 0
+    marker = os.path.join(
+        os.environ["MAGGY_TPU_ELASTIC_DIR"],
+        "{}_{}_{}".format(int(budget), n, os.getpid()))
+    with open(marker, "a") as f:
+        f.write("x")
+    time.sleep(0.05)
+    return {"metric": 1.0 - (lr - 0.1) ** 2}
+
+
+class TestElasticChipLeasing:
+    def test_budget_sized_subslices(self, local_env, tmp_path, monkeypatch):
+        """SURVEY §7.3's central systems problem, virtually: ASHA promotes
+        trials to bigger budgets; promoted budget-9 trials require 2-chip
+        sub-slices, so 1-chip runners exit and respawn re-pinned (driver
+        RESIZE protocol + ElasticTPURunnerPool chip leasing). Every trial
+        must run on exactly the sub-slice size its budget maps to, and
+        the schedule must complete."""
+        from maggy_tpu.optimizers import Asha
+
+        d = tmp_path / "elastic"
+        d.mkdir()
+        monkeypatch.setenv("MAGGY_TPU_ELASTIC_DIR", str(d))
+        config = OptimizationConfig(
+            name="elastic_e2e", num_trials=9,
+            optimizer=Asha(reduction_factor=3, resource_min=1,
+                           resource_max=9, seed=0),
+            searchspace=space(), direction="max", num_workers=2,
+            hb_interval=0.1, seed=4, es_policy="none",
+            pool="elastic", chips_per_trial=1, total_chips=4,
+            chips_per_budget={1: 1, 3: 1, 9: 2},
+        )
+        result = experiment.lagom(train_elastic, config)
+        markers = os.listdir(d)
+        assert markers, "no trials recorded"
+        for m in markers:
+            budget, chips, _ = m.split("_")
+            assert (chips == "2") == (budget == "9"), \
+                "budget {} ran on {} chip(s): {}".format(budget, chips, markers)
+        # The promotion chain reached the 2-chip rung.
+        assert any(m.startswith("9_") for m in markers), markers
+        assert result["num_trials"] >= 9
+
+    def test_pool_migrates_through_three_rung_sizes(self, local_env,
+                                                    tmp_path, monkeypatch):
+        """Chips must MIGRATE as rungs drain: 2 one-chip workers (4-chip
+        lease budget) serve rung 0, then resize to 2-chip slices for rung
+        1, then consolidate into one 4-chip slice for the final rung —
+        exercising park, herd-bounded migration, and retirement."""
+        from maggy_tpu.optimizers import Asha
+
+        d = tmp_path / "elastic3"
+        d.mkdir()
+        monkeypatch.setenv("MAGGY_TPU_ELASTIC_DIR", str(d))
+        config = OptimizationConfig(
+            name="elastic_rungs", num_trials=9,
+            optimizer=Asha(reduction_factor=3, resource_min=1,
+                           resource_max=9, seed=1),
+            searchspace=space(), direction="max", num_workers=2,
+            hb_interval=0.1, seed=6, es_policy="none",
+            pool="elastic", chips_per_trial=1, total_chips=4,
+            chips_per_budget={1: 1, 3: 2, 9: 4},
+        )
+        result = experiment.lagom(train_elastic, config)
+        markers = os.listdir(d)
+        expect = {"1": "1", "3": "2", "9": "4"}
+        for m in markers:
+            budget, chips, _ = m.split("_")
+            assert chips == expect[budget], (m, markers)
+        assert {m.split("_")[0] for m in markers} == {"1", "3", "9"}
+        assert result["num_trials"] >= 9
 
 
 class TestHeartbeatLossE2E:
