@@ -42,6 +42,11 @@ def make_data(n=2048, key=0):
 
 
 DATA_X, DATA_Y = make_data()
+# Mirror of runner_pool._ACCEL_BOOTSTRAP_VARS (NOT imported: the
+# orchestrator process deliberately never imports maggy_tpu/jax). Vars that
+# make a TPU-plugin sitecustomize dial the accelerator tunnel at child
+# interpreter startup; CPU-bound invocations must strip them.
+_ACCEL_BOOTSTRAP_VARS = ("PALLAS_AXON_POOL_IPS",)
 STEPS_PER_BUDGET = int(os.environ.get("BENCH_STEPS", "40"))
 # Swept batch sizes: trial DURATION varies ~4x across the space — the
 # normal shape of a real sweep (batch/width/depth hparams change cost), and
@@ -623,6 +628,97 @@ def _probe_device(timeout_s):
         return False
 
 
+def _remediate_device():
+    """Best-effort cleanup of stale-claim causes THIS repo's own runs can
+    create, between probe attempts. Two known sources (BASELINE.md, the
+    round-3 incident): (1) an orphaned bench/runner child from a previous
+    run still holding the single-client tunnel claim; (2) a stale libtpu
+    lockfile left by a killed process. Only processes that are clearly
+    ours (cmdline mentions this repo's bench/runner entry points) and
+    orphaned (reparented to init) are touched — never the driver, the
+    judge, or live experiments."""
+    import glob
+    import signal
+
+    killed = []
+    try:
+        my_pid = os.getpid()
+        for status_path in glob.glob("/proc/[0-9]*/cmdline"):
+            pid = int(status_path.split("/")[2])
+            if pid == my_pid:
+                continue
+            try:
+                with open(status_path, "rb") as f:
+                    cmd = f.read().replace(b"\x00", b" ").decode(
+                        "utf-8", "replace")
+                with open("/proc/{}/stat".format(pid)) as f:
+                    ppid = int(f.read().split(")")[-1].split()[1])
+            except (OSError, ValueError, IndexError):
+                continue
+            ours = ("bench.py --headline" in cmd or "bench.py --extra" in cmd
+                    or "maggy_tpu.runner" in cmd
+                    or "multiprocessing.spawn" in cmd and "maggy" in cmd)
+            if ours and ppid == 1:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                    killed.append(pid)
+                except OSError:
+                    pass
+    except Exception:  # noqa: BLE001 - remediation must never break the bench
+        pass
+    import fcntl
+
+    for lock in glob.glob("/tmp/libtpu_lockfile*") + glob.glob(
+            "/tmp/tpu_lockfile*"):
+        # Only delete STALE lockfiles: a live holder keeps its flock, so a
+        # successful non-blocking flock proves nobody holds it. Deleting a
+        # held lockfile would let two processes both claim the device once
+        # the holder's claim frees — worse than the wedge being remediated.
+        try:
+            fd = os.open(lock, os.O_RDWR)
+        except OSError:
+            continue
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            os.unlink(lock)
+            killed.append(lock)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
+    if killed:
+        log("remediation removed stale claim-holders/locks: {}".format(killed))
+
+
+def _probe_device_with_retry(budget_s):
+    """Spend the WHOLE probe budget trying to reach the device: probe,
+    remediate (kill this repo's orphaned claim-holders, clear stale
+    lockfiles), probe again — so a chip that recovers anywhere inside the
+    window is caught, instead of one early probe deciding the round
+    (the r3/r4 failure mode: both artifacts were information-free 0.0s
+    from a single probe at an unlucky moment)."""
+    single = float(os.environ.get("BENCH_PROBE_ATTEMPT_S", "75"))
+    deadline = time.monotonic() + budget_s
+    attempt = 0
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        attempt += 1
+        t0 = time.time()
+        if _probe_device(min(single, max(15.0, remaining))):
+            if attempt > 1:
+                log("device answered on probe attempt {}".format(attempt))
+            return True
+        log("device probe attempt {} failed after {:.0f}s; remediating".format(
+            attempt, time.time() - t0))
+        _remediate_device()
+        # A hung probe consumed its full timeout already; only sleep when
+        # the probe failed fast (plugin error), to avoid hammering.
+        if time.time() - t0 < 10:
+            time.sleep(min(30.0, max(0.0, deadline - time.monotonic())))
+
+
 def main():
     """Orchestrator. Never imports jax in this process — every measurement
     runs in a killable child, so no code path here can hold (or leak) a
@@ -638,11 +734,36 @@ def main():
     # Share one base dir + compile cache across children.
     os.environ.setdefault("MAGGY_TPU_BASE_DIR", tempfile.mkdtemp(prefix="bench_"))
 
-    if not _probe_device(float(os.environ.get("BENCH_DEVICE_PROBE_S", "300"))):
-        print(json.dumps(_failure_artifact(
-            "device unavailable: jax.devices() did not return within the "
-            "probe budget")), flush=True)
-        return 1
+    # A CPU-pinned invocation (JAX_PLATFORMS=cpu rehearsal) must not let the
+    # children's sitecustomize dial the accelerator tunnel at interpreter
+    # startup — that can hang before any in-process guard runs.
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        for var in _ACCEL_BOOTSTRAP_VARS:
+            os.environ.pop(var, None)
+
+    cpu_fallback = False
+    if not _probe_device_with_retry(
+            float(os.environ.get("BENCH_DEVICE_PROBE_S", "300"))):
+        if os.environ.get("BENCH_CPU_FALLBACK", "1") != "1":
+            print(json.dumps(_failure_artifact(
+                "device unavailable: jax.devices() did not return within the "
+                "probe budget (multiple probe+remediate attempts)")), flush=True)
+            return 1
+        # The accelerator never answered: measure the framework on CPU
+        # rather than emit an information-free 0.0. The artifact says so
+        # loudly — a proxy number is comparable (both sides of vs_baseline
+        # run on the same substrate) but it is NOT an on-chip result.
+        log("device unavailable after full probe window; falling back to "
+            "a CPU-proxy headline (detail.platform marks it)")
+        cpu_fallback = True
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        # CRITICAL: also drop the accelerator-bootstrap env vars, or the
+        # children's sitecustomize dials the wedged tunnel at interpreter
+        # startup — before their JAX_PLATFORMS=cpu guard can run — and the
+        # fallback hangs in exactly the scenario it exists for.
+        for var in _ACCEL_BOOTSTRAP_VARS:
+            os.environ.pop(var, None)
+        os.environ.setdefault("BENCH_SKIP_EXTRAS", "1")
 
     status, headline = _run_child(
         ["--headline"], float(os.environ.get("BENCH_HEADLINE_TIMEOUT_S", "2400")))
@@ -656,6 +777,10 @@ def main():
             detail += ": " + headline["stderr_tail"][-500:]
         print(json.dumps(_failure_artifact(detail)), flush=True)
         return 1
+    if cpu_fallback:
+        headline.setdefault("detail", {})["platform"] = (
+            "cpu PROXY FALLBACK — TPU unavailable for the whole probe "
+            "window; both sweep and baselines ran on host CPU")
     # Print the headline IMMEDIATELY — before extras can touch the device.
     print(json.dumps(headline), flush=True)
     if status == "crash" or headline.get("value", 0) == 0:

@@ -23,6 +23,11 @@ DRIVER_IDLE_REQUEUE_TICK_S = 0.1
 CLIENT_GET_POLL_MIN_S = 0.005
 CLIENT_POLL_INTERVAL_S = 1.0
 REGISTRATION_TIMEOUT_S = 600.0
+# Bound between an elastic RESIZE request and the respawned runner's
+# REGISTER. A respawn that wedges before registering (e.g. a stale device
+# claim at backend init) never heartbeats, so heartbeat-loss detection
+# cannot see it — this is its liveness bound.
+RESIZE_RESPAWN_TIMEOUT_S = 120.0
 RENDEZVOUS_TIMEOUT_S = 60.0
 CLIENT_MAX_RETRIES = 3
 RPC_RECV_BUFSIZE = 1 << 16

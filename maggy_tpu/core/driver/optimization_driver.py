@@ -94,6 +94,9 @@ class OptimizationDriver(Driver):
         # migration so a herd of idle runners doesn't all chase one parked
         # trial's size (decremented when a runner REGisters at that size).
         self._resize_inflight: Dict[int, int] = {}
+        # partition_id -> (monotonic request time, target chips): liveness
+        # watch on resize respawns (see periodic_check).
+        self._resize_watch: Dict[int, tuple] = {}
         # Arm heartbeat-loss detection (SURVEY.md §5.3): a silent runner's
         # trial is requeued to whichever runner asks for work next.
         self.server.hb_loss_timeout = getattr(config, "hb_loss_timeout", None) or max(
@@ -389,6 +392,7 @@ class OptimizationDriver(Driver):
                 if demand[size] > supply:
                     self._resize_inflight[size] = \
                         self._resize_inflight.get(size, 0) + 1
+                    self._resize_watch[partition_id] = (time.monotonic(), size)
                     self.server.reservations.request_resize(partition_id, size)
                     self._log("idle runner {} (capacity {}) resized toward "
                               "waiting work ({} chips)".format(
@@ -396,14 +400,62 @@ class OptimizationDriver(Driver):
                     return True
         # Demand covered: this runner's size serves nothing that remains —
         # retire it so its chips free up for the pending spawns. Never
-        # retire the LAST live runner: a fully retired pool has nobody
-        # left to poll for work if a spawn fails.
-        if sum(live.values()) <= 1:
+        # retire the LAST live runner UNLESS a resize respawn is already in
+        # flight: that respawn re-registers and polls, so the pool is not
+        # left pollerless — and NOT retiring would deadlock it (the pending
+        # bigger spawn waits on exactly the chips this idle runner holds;
+        # observed as TestElasticChipLeasing hanging at the 2+2 -> 4
+        # consolidation when the resizing runner was already released).
+        with self._store_lock:
+            inflight = sum(self._resize_inflight.values())
+        if sum(live.values()) <= 1 and inflight == 0:
             return False
         self.server.reservations.request_resize(partition_id, 0)
         self._log("idle runner {} (capacity {}) retired; chips released "
                   "for pending resizes".format(partition_id, cap))
         return True
+
+    def periodic_check(self) -> None:
+        """Server event-loop hook: bound resize-respawn registration.
+
+        A respawn that wedges BEFORE registering (stale device claim at
+        backend init) never heartbeats, so heartbeat-loss detection cannot
+        see it — and with the last-runner retire rule the pool may have
+        nobody else polling. Expired respawns are killed via the pool,
+        which turns a silent infinite wait into a loud runner failure the
+        driver surfaces. An expired entry whose process was still QUEUED
+        for chips (kill_worker finds nothing) merely loses its in-flight
+        credit — worst case another idle runner re-chases the demand."""
+        pool = getattr(self, "_active_pool", None)
+        age_of = getattr(pool, "spawn_age", None)
+        now = time.monotonic()
+        expired = []
+        with self._store_lock:
+            for pid, (t0, size) in list(self._resize_watch.items()):
+                if now - t0 <= constants.RESIZE_RESPAWN_TIMEOUT_S:
+                    continue
+                # Only the SPAWNED-but-silent case is pathological. A
+                # respawn still queued for chips (spawn_age None) is
+                # healthy waiting — e.g. behind another runner's
+                # minutes-long trial — so its watch is re-armed, not
+                # expired (expiring it would drop the in-flight credit a
+                # later REGISTER then double-decrements).
+                age = age_of(pid) if age_of is not None else now - t0
+                if age is None:
+                    self._resize_watch[pid] = (now, size)
+                    continue
+                if age <= constants.RESIZE_RESPAWN_TIMEOUT_S:
+                    continue
+                del self._resize_watch[pid]
+                if self._resize_inflight.get(size, 0) > 0:
+                    self._resize_inflight[size] -= 1
+                expired.append((pid, size))
+        for pid, size in expired:
+            self._log("resize respawn for runner {} ({} chips) spawned but "
+                      "did not re-register within {:.0f}s; killing it".format(
+                          pid, size, constants.RESIZE_RESPAWN_TIMEOUT_S))
+            if pool is not None:
+                pool.kill_worker(pid)
 
     def _pop_parked(self, capacity: Optional[int]) -> Optional[Trial]:
         """First parked trial this runner's capacity can serve (None
@@ -479,6 +531,7 @@ class OptimizationDriver(Driver):
             with self._store_lock:
                 if self._resize_inflight.get(cap, 0) > 0:
                     self._resize_inflight[cap] -= 1
+                self._resize_watch.pop(msg["partition_id"], None)
         self._assign_next(msg["partition_id"], None)
 
     def _idle_msg_callback(self, msg) -> None:
@@ -629,6 +682,7 @@ class OptimizationDriver(Driver):
                     # also chase the same trial.
                     self._resize_inflight[need] = \
                         self._resize_inflight.get(need, 0) + 1
+                    self._resize_watch[partition_id] = (time.monotonic(), need)
                 self.server.reservations.request_resize(partition_id, need)
                 self._log("trial {} needs {} chip(s); runner {} (capacity "
                           "{}) asked to resize".format(

@@ -233,6 +233,9 @@ class Server:
 
     def __init__(self, num_executors: int, secret: Optional[str] = None):
         self.num_executors = num_executors
+        # One-shot flag so a broken periodic_check hook logs ONCE instead of
+        # spamming (or silently dying) on every event-loop tick.
+        self._periodic_check_failed = False
         self.secret_hex = secret or pysecrets.token_hex(16)
         self.secret = self.secret_hex.encode()
         self.reservations = Reservations(num_executors)
@@ -481,12 +484,26 @@ class OptimizationServer(Server):
         )
 
     def _tick(self) -> None:
-        if self.hb_loss_timeout is None or self.driver is None:
+        if self.driver is None:
             return
         now = time.monotonic()
-        if now - self._last_loss_scan < min(1.0, self.hb_loss_timeout / 4):
+        gate = min(1.0, self.hb_loss_timeout / 4) \
+            if self.hb_loss_timeout is not None else 1.0
+        if now - self._last_loss_scan < gate:
             return
         self._last_loss_scan = now
+        check = getattr(self.driver, "periodic_check", None)
+        if check is not None:
+            try:
+                check()
+            except Exception:  # noqa: BLE001 - never kill the event loop
+                if not self._periodic_check_failed:
+                    self._periodic_check_failed = True
+                    import traceback
+
+                    traceback.print_exc()
+        if self.hb_loss_timeout is None:
+            return
         for pid, trial_id in self.reservations.lost_assignments(self.hb_loss_timeout):
             # Clear the assignment first so a racing re-registration takes
             # the BLACK path instead of double-requeueing this trial.

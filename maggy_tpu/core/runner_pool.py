@@ -21,9 +21,47 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import threading
+import time
 import traceback
 from abc import ABC, abstractmethod
 from typing import Callable, List, Optional
+
+
+# Env vars that make a TPU-plugin sitecustomize bootstrap (and therefore
+# import jax + dial the accelerator tunnel) at interpreter startup in EVERY
+# child python process. A child that is pinned to CPU must never pay that
+# cost: it cannot use the chip, the bootstrap import dominates spawn latency
+# on a loaded host, and a wedged accelerator claim can hang the child before
+# it reaches user code. Interpreter-startup hooks run before
+# ``_process_entry`` executes, so these must be stripped in the PARENT
+# around ``Process.start()``.
+_ACCEL_BOOTSTRAP_VARS = ("PALLAS_AXON_POOL_IPS",)
+
+_spawn_env_lock = threading.Lock()
+
+
+class _cpu_child_env:
+    """Context manager: while spawning, drop accelerator-bootstrap env vars
+    when the child is CPU-bound (JAX_PLATFORMS=cpu), so its interpreter
+    starts without importing jax or touching the accelerator. No-op when
+    the child may need the accelerator."""
+
+    def __enter__(self):
+        self._saved = {}
+        self._active = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        if not self._active:
+            return self
+        _spawn_env_lock.acquire()
+        for k in _ACCEL_BOOTSTRAP_VARS:
+            if k in os.environ:
+                self._saved[k] = os.environ.pop(k)
+        return self
+
+    def __exit__(self, *exc):
+        if self._active:
+            os.environ.update(self._saved)
+            _spawn_env_lock.release()
+        return False
 
 
 _DEVICE_PROBE_CODE = """\
@@ -51,10 +89,14 @@ def _probe_local_devices(timeout_s: float = 120.0):
     import subprocess
     import sys
 
+    env = None
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        env = {k: v for k, v in os.environ.items()
+               if k not in _ACCEL_BOOTSTRAP_VARS}
     out = subprocess.run(
         [sys.executable, "-c", _DEVICE_PROBE_CODE],
         timeout=timeout_s, stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL).stdout
+        stderr=subprocess.DEVNULL, env=env).stdout
     chips, devices = out.decode().split()
     return int(chips), int(devices)
 
@@ -201,12 +243,13 @@ class ProcessRunnerPool(RunnerPool):
     def run(self, worker_fn: Callable[[int], None]) -> List[BaseException]:
         ctx = mp.get_context(self.start_method)
         procs = []
-        for i in range(self.num_workers):
-            env = self.chip_env_fn(i) if self.chip_env_fn else {}
-            p = ctx.Process(target=_process_entry, args=(worker_fn, i, env),
-                            name="runner-{}".format(i))
-            p.start()
-            procs.append(p)
+        with _cpu_child_env():
+            for i in range(self.num_workers):
+                env = self.chip_env_fn(i) if self.chip_env_fn else {}
+                p = ctx.Process(target=_process_entry, args=(worker_fn, i, env),
+                                name="runner-{}".format(i))
+                p.start()
+                procs.append(p)
         self._procs = procs
         failures: List[BaseException] = []
         for p in procs:
@@ -271,8 +314,21 @@ class ElasticTPURunnerPool(RunnerPool):
 
         self.resize_dir = resize_dir or tempfile.mkdtemp(prefix="maggy_resize_")
         self._procs: dict = {}  # pid -> (process, chips_set)
+        self._spawn_time: dict = {}  # pid -> monotonic start of current proc
         self._free: set = set()
         self._lock = threading.Lock()
+
+    def spawn_age(self, partition_id: int):
+        """Seconds since partition's CURRENT process was spawned, or None
+        when no process exists (respawn still queued for chips). The
+        driver's resize watchdog keys off this: a queued respawn is
+        healthy waiting, only a spawned-but-never-registered process is
+        evidence of a wedged startup."""
+        with self._lock:
+            if partition_id not in self._procs:
+                return None
+            t0 = self._spawn_time.get(partition_id)
+        return None if t0 is None else time.monotonic() - t0
 
     def _resize_file(self, partition_id: int) -> str:
         return os.path.join(self.resize_dir, "{}.resize".format(partition_id))
@@ -287,8 +343,10 @@ class ElasticTPURunnerPool(RunnerPool):
         p = ctx.Process(target=_process_entry,
                         args=(worker_fn, partition_id, env),
                         name="runner-{}".format(partition_id))
-        p.start()
+        with _cpu_child_env():
+            p.start()
         self._procs[partition_id] = (p, chips)
+        self._spawn_time[partition_id] = time.monotonic()
 
     def kill_worker(self, partition_id: int) -> bool:
         with self._lock:

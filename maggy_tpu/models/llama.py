@@ -76,7 +76,8 @@ class LlamaConfig:
 
     @staticmethod
     def llama3_8b(lora_rank: int = 16) -> "LlamaConfig":
-        return LlamaConfig(lora_rank=lora_rank)
+        # 8.03B params: the Llama-3 128k vocabulary, not Llama-2's 32k.
+        return LlamaConfig(vocab_size=128256, lora_rank=lora_rank)
 
 
 def _rms_norm(x, weight, eps):
@@ -113,8 +114,9 @@ def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
 class LoRADense(nn.Module):
     """Dense with an optional frozen-base + low-rank adapter.
 
-    Adapter params live in a separate 'lora' collection so an optimizer can
-    train only them (see train/lora.py for the partition helper).
+    Adapter params are the `lora_a`/`lora_b` leaves of the params tree;
+    `train.lora.only_lora(tx)` masks an optimizer so only they train (and
+    only they carry optimizer state — the 8B-scale memory win).
     """
 
     features: int

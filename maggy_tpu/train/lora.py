@@ -1,0 +1,62 @@
+"""LoRA training utilities: freeze the base model, train only adapters.
+
+The flagship sweep (BASELINE.md config 5, "Llama-3-8B LoRA hyperparameter
+sweep") trains ONLY the low-rank adapter matrices injected by
+`models.llama.LoRADense` (`lora_a` / `lora_b` leaves of the params tree).
+`optax.masked` gives exactly that: masked-out (frozen) parameters get no
+optimizer state at all, so at 8B scale the Adam moments shrink from
+~64 GB (2 x fp32 x 8B) to megabytes — the difference between a sweep that
+fits a v4-32 slice and one that does not.
+
+The reference has no model/optimizer code (SURVEY.md §5.7); this module is
+part of the TPU-native training surface around the sweep framework.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import optax
+
+
+def _is_lora_path(path) -> bool:
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if key in ("lora_a", "lora_b"):
+            return True
+    return False
+
+
+def lora_mask(params) -> Any:
+    """Boolean pytree: True on `lora_a`/`lora_b` leaves, False elsewhere.
+
+    Works on concrete params, `jax.eval_shape` outputs, and the full
+    variables dict (mask follows structure).
+    """
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: _is_lora_path(path), params)
+
+
+def lora_adapter_count(params) -> int:
+    """Number of trainable (adapter) parameters in ``params``."""
+    total = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        if _is_lora_path(path) and hasattr(leaf, "shape"):
+            size = 1
+            for d in leaf.shape:
+                size *= int(d)
+            total += size
+    return total
+
+
+def only_lora(tx: optax.GradientTransformation) -> optax.GradientTransformation:
+    """Wrap ``tx`` so it updates ONLY LoRA adapter leaves.
+
+    Frozen (base-model) leaves receive zero updates and allocate no
+    optimizer state (`optax.masked` stores a placeholder for them).
+    Use with any optax optimizer::
+
+        tx = only_lora(optax.adamw(lr))
+    """
+    return optax.masked(tx, lora_mask)

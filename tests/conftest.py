@@ -23,6 +23,48 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test; deselect with -m 'not slow'")
+    config.addinivalue_line(
+        "markers",
+        "timeout(seconds): hard SIGALRM bound — the test FAILS with a "
+        "TimeoutError instead of silently eating a CI budget")
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout(request):
+    """Enforce @pytest.mark.timeout(N) without the pytest-timeout plugin.
+
+    SIGALRM interrupts the main thread wherever it is blocked (joins, lock
+    waits, subprocess polls), so a livelocked test surfaces as a failed
+    test with a stack trace, not a hung CI job. Worker threads/processes
+    the test leaked are cleaned by their own daemon/terminate paths."""
+    m = request.node.get_closest_marker("timeout")
+    if m is None:
+        yield
+        return
+    import signal
+    import threading
+
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    seconds = int(m.args[0])
+
+    def _abort(signum, frame):
+        raise TimeoutError(
+            "test exceeded its {}s hard timeout (marker)".format(seconds))
+
+    old = signal.signal(signal.SIGALRM, _abort)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
 @pytest.fixture
 def tmp_experiment_dir(tmp_path):
     d = tmp_path / "experiments"

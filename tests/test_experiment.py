@@ -388,6 +388,13 @@ def train_elastic(lr, units, budget=1, reporter=None):
 
 
 class TestElasticChipLeasing:
+    # Each rung migration respawns pinned worker processes; before
+    # runner_pool._cpu_child_env stripped the accelerator-bootstrap env
+    # vars, every spawn paid a sitecustomize jax import + tunnel dial
+    # (minutes each on a loaded host with a wedged relay). The hard
+    # timeout turns any regression back into that livelock into a FAILED
+    # test in one minute instead of a silently-eaten CI budget.
+    @pytest.mark.timeout(90)
     def test_budget_sized_subslices(self, local_env, tmp_path, monkeypatch):
         """SURVEY §7.3's central systems problem, virtually: ASHA promotes
         trials to bigger budgets; promoted budget-9 trials require 2-chip
@@ -420,6 +427,7 @@ class TestElasticChipLeasing:
         assert any(m.startswith("9_") for m in markers), markers
         assert result["num_trials"] >= 9
 
+    @pytest.mark.timeout(90)
     def test_pool_migrates_through_three_rung_sizes(self, local_env,
                                                     tmp_path, monkeypatch):
         """Chips must MIGRATE as rungs drain: 2 one-chip workers (4-chip
