@@ -88,7 +88,8 @@ class OptimizationConfig(LagomConfig):
     experiment_dir: Optional[str] = None
     # Resume the most recent interrupted run of this app: finalized trials
     # are reloaded from their trial.json artifacts and skipped; unfinished
-    # ones re-run. Not supported with a pruner schedule.
+    # ones re-run. Pruner (Hyperband/ASHA bracket) state restores from its
+    # checkpoint; sampling optimizers must carry a fixed seed.
     resume: bool = False
 
     def __post_init__(self):

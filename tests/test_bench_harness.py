@@ -20,6 +20,10 @@ import os
 import subprocess
 import sys
 
+# Heavy module (e2e / sharded-compile tests): excluded from the fast lane
+# (pytest -m 'not slow').
+pytestmark = __import__('pytest').mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -14,6 +14,10 @@ from maggy_tpu.core.environment import EnvSing
 from maggy_tpu.core.environment.abstractenvironment import LocalEnv
 from maggy_tpu.parallel import ShardingEnv, make_mesh, shard_params
 
+# Heavy module (e2e / sharded-compile tests): excluded from the fast lane
+# (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(autouse=True)
 def local_env(tmp_path):

@@ -16,6 +16,9 @@ from maggy_tpu import experiment
 from maggy_tpu.core.environment import EnvSing
 from maggy_tpu.core.environment.abstractenvironment import LocalEnv
 
+# Heavy module (e2e tests): excluded from the fast lane (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(autouse=True)
 def local_env(tmp_path):
@@ -182,6 +185,7 @@ import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["MAGGY_TPU_BASE_DIR"] = {base!r}
 from maggy_tpu import OptimizationConfig, Searchspace, experiment
+
 
 config = OptimizationConfig(
     name="startup", num_trials=2, optimizer="randomsearch",

@@ -13,6 +13,10 @@ from maggy_tpu.parallel import make_mesh
 from maggy_tpu.train import ShardedBatchIterator, Trainer, cross_entropy_loss
 from maggy_tpu.train.trainer import next_token_loss
 
+# Heavy module (e2e / sharded-compile tests): excluded from the fast lane
+# (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 def _qkv(rng, B, Sq, H, D, Sk=None, Hkv=None):
     Sk = Sq if Sk is None else Sk

@@ -23,6 +23,10 @@ from maggy_tpu.parallel.pipeline import stage_param_sharding
 from maggy_tpu.train import Trainer
 from maggy_tpu.train.trainer import next_token_loss
 
+# Heavy module (e2e / sharded-compile tests): excluded from the fast lane
+# (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 def tokens_batch(B=4, S=64, vocab=256, seed=0):
     rng = np.random.default_rng(seed)

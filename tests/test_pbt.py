@@ -13,6 +13,10 @@ from maggy_tpu.trial import Trial
 
 from tests.test_optimizers import finalize, wire
 
+# Heavy module (e2e / sharded-compile tests): excluded from the fast lane
+# (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 def space():
     return Searchspace(lr=("DOUBLE", [0.001, 1.0]),

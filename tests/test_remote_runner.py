@@ -22,6 +22,10 @@ from maggy_tpu.core.environment.abstractenvironment import LocalEnv
 from maggy_tpu.core.rpc import OptimizationServer
 from maggy_tpu.runner import join_experiment, load_train_fn
 
+# Heavy module (e2e / sharded-compile tests): excluded from the fast lane
+# (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
 
 

@@ -9,6 +9,9 @@ import pytest
 
 from maggy_tpu import OptimizationConfig, Searchspace, experiment
 from maggy_tpu.core.environment import EnvSing
+
+# Heavy module (e2e tests): excluded from the fast lane (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
 from maggy_tpu.core.environment.abstractenvironment import LocalEnv
 from maggy_tpu.optimizers import Asha
 from maggy_tpu.trial import Trial
@@ -186,6 +189,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 from maggy_tpu import OptimizationConfig, Searchspace, experiment
 from maggy_tpu.optimizers import RandomSearch
+
 
 def train(lr, units, budget=1, reporter=None):
     marker = os.path.join(os.environ["MAGGY_TEST_COUNT_DIR"],

@@ -9,6 +9,10 @@ from maggy_tpu.ops.attention import attention_reference
 from maggy_tpu.parallel import make_mesh
 from maggy_tpu.parallel.ring_attention import ring_attention
 
+# Heavy module (e2e / sharded-compile tests): excluded from the fast lane
+# (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 def qkv(B=2, S=64, H=2, D=16, seed=0):
     rng = np.random.default_rng(seed)

@@ -12,6 +12,10 @@ from maggy_tpu import OptimizationConfig, Searchspace, experiment
 from maggy_tpu.core.environment import EnvSing
 from maggy_tpu.core.environment.abstractenvironment import LocalEnv
 
+# Heavy module (e2e / sharded-compile tests): excluded from the fast lane
+# (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(autouse=True)
 def local_env(tmp_path):
