@@ -113,10 +113,25 @@ def default_dataset_generator(study, ablated_feature: Optional[str] = None):
     arrays or an .npz/.parquet path) — the local analogue of the reference
     reading the feature store minus the ablated feature (`loco.py:41-80`)."""
     src = getattr(study, "train_set", None)
+    if src is None and getattr(study, "name", ""):
+        # The reference resolves (training_dataset_name, version) through
+        # the feature store (`loco.py:41-80`); here the same pair resolves
+        # through the dataset registry (train/registry.py) — but only if
+        # the name is actually registered, so an unregistered study keeps
+        # the actionable "no dataset source" error below.
+        from maggy_tpu.train.registry import DatasetRegistry
+
+        try:
+            reg = DatasetRegistry()
+            if study.version in reg.versions(study.name):
+                src = "registry://{}@{}".format(study.name, study.version)
+        except Exception:  # noqa: BLE001 - registry probe must not mask the error
+            pass
     if src is None:
         raise ValueError(
             "No dataset source: pass train_set= (dict of arrays or a "
-            "dataset path) or dataset_generator= to AblationStudy."
+            "dataset path), training_dataset_name= registered in the "
+            "dataset registry, or dataset_generator= to AblationStudy."
         )
     from maggy_tpu.train.data import feature_dropping_generator
 

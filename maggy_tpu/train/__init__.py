@@ -7,7 +7,8 @@ from maggy_tpu.train.trainer import (
     Trainer,
 )
 from maggy_tpu.train.data import ShardedBatchIterator
+from maggy_tpu.train.registry import DatasetRegistry
 
 __all__ = ["cross_entropy_loss", "init_train_state", "make_train_step",
            "next_token_loss", "swept_transform", "Trainer",
-           "ShardedBatchIterator"]
+           "ShardedBatchIterator", "DatasetRegistry"]

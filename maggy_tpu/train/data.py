@@ -239,14 +239,20 @@ def load_path_dataset(path, columns=None, file_shard=None):
     Supported formats: a ``.npz`` archive, a single ``.parquet`` file, a
     directory of ``.parquet`` files, a ``.tfrecord``/``.tfrecords`` file,
     or a directory of them (the reference's feature-store format,
-    `loco.py:41-80`). ``file_shard=(current, count)`` restricts a
+    `loco.py:41-80`), plus ``registry://name[@version]`` URIs resolved
+    through the dataset registry (train/registry.py — the featurestore-
+    equivalent indirection). ``file_shard=(current, count)`` restricts a
     parquet/tfrecord directory to files ``[current::count]`` (file-level
     sharding; single files and npz archives reject it — there is nothing to
     split without reading everything anyway).
     """
     import os
 
+    from maggy_tpu.train import registry as _reg
     from maggy_tpu.train import tfrecord as _tfr
+
+    if _reg.is_registry_uri(path):
+        path = _reg.resolve_path(path)
 
     if _tfr.is_tfrecord_path(path):
         if os.path.isdir(path):

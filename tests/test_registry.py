@@ -1,0 +1,107 @@
+"""Dataset registry: the featurestore-equivalent named/versioned dataset
+surface (reference: Hopsworks feature-store accessors,
+`abstractenvironment.py`; LOCO schema reads, `loco.py:41-80`)."""
+
+import numpy as np
+import pytest
+
+from maggy_tpu.core.environment import EnvSing
+from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+from maggy_tpu.train.registry import (
+    DatasetRegistry,
+    is_registry_uri,
+    parse_uri,
+    resolve_path,
+)
+
+
+@pytest.fixture(autouse=True)
+def local_env(tmp_path):
+    env = LocalEnv(base_dir=str(tmp_path / "base"))
+    EnvSing.set_instance(env)
+    yield env
+    EnvSing.reset()
+
+
+def _write_npz(tmp_path, name="d.npz"):
+    p = str(tmp_path / name)
+    np.savez(p, x=np.arange(12, dtype=np.float32).reshape(6, 2),
+             y=np.arange(6, dtype=np.int64))
+    return p
+
+
+class TestRegistry:
+    def test_register_infers_schema_and_autoversions(self, tmp_path):
+        reg = DatasetRegistry()
+        p = _write_npz(tmp_path)
+        v1 = reg.register("toy", p, description="first cut")
+        v2 = reg.register("toy", p)
+        assert (v1, v2) == (1, 2)
+        assert reg.versions("toy") == [1, 2]
+        assert reg.names() == ["toy"]
+        m = reg.get("toy")  # latest
+        assert m["version"] == 2 and m["path"] == p and m["format"] == "npz"
+        assert m["schema"] == {"x": "float32", "y": "int64"}
+        assert reg.features("toy") == ["x", "y"]
+
+    def test_versions_are_immutable(self, tmp_path):
+        reg = DatasetRegistry()
+        p = _write_npz(tmp_path)
+        reg.register("toy", p, version=3)
+        with pytest.raises(ValueError, match="immutable"):
+            reg.register("toy", p, version=3)
+
+    def test_unknown_lookups_raise(self):
+        reg = DatasetRegistry()
+        with pytest.raises(KeyError, match="No dataset"):
+            reg.get("ghost")
+        reg2 = DatasetRegistry()
+        with pytest.raises(ValueError, match="no '/' or '@'"):
+            reg2.register("bad@name", "x.npz")
+
+    def test_uri_parsing(self):
+        assert parse_uri("registry://toy") == ("toy", None)
+        assert parse_uri("registry://toy@7") == ("toy", 7)
+        with pytest.raises(ValueError, match="registry://name@<int>"):
+            parse_uri("registry://toy@latest")
+        assert is_registry_uri("registry://toy")
+        assert not is_registry_uri("/data/toy.npz")
+        assert not is_registry_uri({"x": 1})
+
+    def test_loader_resolves_registry_uri(self, tmp_path):
+        from maggy_tpu.train.data import load_path_dataset
+
+        reg = DatasetRegistry()
+        p = _write_npz(tmp_path)
+        reg.register("toy", p)
+        data = load_path_dataset("registry://toy@1")
+        assert set(data) == {"x", "y"}
+        assert data["x"].shape == (6, 2)
+        assert resolve_path("registry://toy") == p
+
+    def test_iterator_from_registry_uri(self, tmp_path):
+        from maggy_tpu.train import ShardedBatchIterator
+
+        reg = DatasetRegistry()
+        reg.register("toy", _write_npz(tmp_path))
+        it = ShardedBatchIterator.from_path(
+            "registry://toy", batch_size=3, epochs=1)
+        batches = list(it)
+        assert len(batches) == 2
+        assert batches[0]["x"].shape == (3, 2)
+
+    def test_ablation_study_registry_train_set(self, tmp_path):
+        """LOCO's default generator reads the train_set through the
+        registry URI — the reference's feature-store indirection."""
+        from maggy_tpu.ablation.ablationstudy import AblationStudy
+        from maggy_tpu.ablation.ablator.loco import default_dataset_generator
+
+        reg = DatasetRegistry()
+        reg.register("toy", _write_npz(tmp_path))
+        study = AblationStudy(training_dataset_name="toy",
+                              training_dataset_version=1)
+        study.features.include("x")
+        full = default_dataset_generator(study)
+        assert set(full) == {"x", "y"}
+        dropped = default_dataset_generator(study, ablated_feature="x")
+        assert set(dropped) == {"y"}

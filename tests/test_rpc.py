@@ -638,3 +638,198 @@ class TestAssignNextDeadPartition:
         assert driver.server.reservations.get_assigned_trial(0) is None
         assert len(driver._requeue) == 1
         assert driver._requeue[0] in driver._trial_store
+
+
+class TestAdversarialFrames:
+    """Semantic robustness against hostile/broken clients: the server must
+    DROP bad connections, never crash, and never double-assign work. The
+    byte-level codec fuzz lives in test_native.py; these cases exercise the
+    server's stateful handling of adversarial frame SEQUENCES."""
+
+    @staticmethod
+    def frame(payload_obj, secret: bytes) -> bytes:
+        import hashlib
+        import hmac
+        import struct
+
+        import msgpack
+
+        payload = msgpack.packb(payload_obj, use_bin_type=True)
+        mac = hmac.new(secret, payload, hashlib.sha256).digest()
+        return struct.pack(">I", len(payload)) + mac + payload
+
+    @staticmethod
+    def recv_reply(sock, secret: bytes, timeout=5.0):
+        import hashlib
+        import hmac as hmac_mod
+        import struct
+
+        import msgpack
+
+        sock.settimeout(timeout)
+        buf = b""
+        while len(buf) < 4 + 32:
+            chunk = sock.recv(4096)
+            if not chunk:
+                return None
+            buf += chunk
+        length = struct.unpack(">I", buf[:4])[0]
+        while len(buf) < 4 + 32 + length:
+            chunk = sock.recv(4096)
+            if not chunk:
+                return None
+            buf += chunk
+        payload = buf[36:36 + length]
+        assert hmac_mod.new(secret, payload, hashlib.sha256).digest() == buf[4:36]
+        return msgpack.unpackb(payload, raw=False)
+
+    def _connect(self, addr):
+        s = socket.create_connection(addr, timeout=5)
+        return s
+
+    def test_truncated_frame_then_close_drops_cleanly(self, opt_server):
+        server, driver, addr = opt_server
+        reg = self.frame({"type": "REG", "partition_id": 0, "host_port": "x",
+                          "task_attempt": 0}, server.secret)
+        s = self._connect(addr)
+        s.sendall(reg[: len(reg) // 2])  # half a frame, then vanish
+        s.close()
+        time.sleep(0.3)
+        # The half-frame must not have been dispatched...
+        assert not driver.messages
+        # ...and the server still serves a well-behaved client.
+        s2 = self._connect(addr)
+        s2.sendall(reg)
+        assert self.recv_reply(s2, server.secret)["type"] == "OK"
+        s2.close()
+
+    def test_slow_loris_fragmented_frame_is_reassembled(self, opt_server):
+        server, driver, addr = opt_server
+        reg = self.frame({"type": "REG", "partition_id": 1, "host_port": "y",
+                          "task_attempt": 0}, server.secret)
+        s = self._connect(addr)
+        for i in range(0, len(reg), 7):  # 7-byte drip
+            s.sendall(reg[i:i + 7])
+            time.sleep(0.01)
+        assert self.recv_reply(s, server.secret)["type"] == "OK"
+        assert server.reservations.get(1) is not None
+        s.close()
+
+    def test_bad_hmac_after_good_frame(self, opt_server):
+        """First frame valid, second corrupt: the valid one is processed,
+        the connection is dropped at the corrupt one, the server lives."""
+        server, driver, addr = opt_server
+        reg = self.frame({"type": "REG", "partition_id": 0, "host_port": "x",
+                          "task_attempt": 0}, server.secret)
+        evil = bytearray(self.frame({"type": "FINAL", "partition_id": 0,
+                                     "value": 1.0}, server.secret))
+        evil[10] ^= 0xFF  # corrupt the MAC
+        s = self._connect(addr)
+        s.sendall(reg + bytes(evil))
+        assert self.recv_reply(s, server.secret)["type"] == "OK"  # the REG
+        # The corrupt frame kills the connection (EOF), not the server.
+        assert s.recv(4096) == b""
+        s.close()
+        assert server.reservations.get(0) is not None
+        assert not any(m.get("type") == "FINAL" for m in driver.messages)
+        # Server still accepting.
+        s2 = self._connect(addr)
+        s2.sendall(self.frame({"type": "QUERY"}, server.secret))
+        assert self.recv_reply(s2, server.secret) is not None
+        s2.close()
+
+    def test_oversized_length_header_drops_connection(self, opt_server):
+        import struct
+
+        server, driver, addr = opt_server
+        s = self._connect(addr)
+        s.sendall(struct.pack(">I", 1 << 30) + b"\x00" * 32)
+        assert s.recv(4096) == b""  # dropped
+        s.close()
+        s2 = self._connect(addr)
+        s2.sendall(self.frame({"type": "QUERY"}, server.secret))
+        assert self.recv_reply(s2, server.secret) is not None
+        s2.close()
+
+    def test_unknown_type_gets_err_not_crash(self, opt_server):
+        server, driver, addr = opt_server
+        s = self._connect(addr)
+        s.sendall(self.frame({"type": "PWN", "partition_id": 0}, server.secret))
+        assert self.recv_reply(s, server.secret)["type"] == "ERR"
+        s.close()
+
+    def test_replayed_get_does_not_double_assign(self, opt_server):
+        """A captured GET frame replayed after FINAL must NOT hand the old
+        trial out again (the assignment was cleared by FINAL)."""
+        server, driver, addr = opt_server
+        trial = Trial({"lr": 0.1})
+        driver.trials[trial.trial_id] = trial
+        server.reservations.add({"partition_id": 0, "host_port": "x",
+                                 "task_attempt": 0, "trial_id": None})
+        server.reservations.assign_trial(0, trial.trial_id)
+        get = self.frame({"type": "GET", "partition_id": 0}, server.secret)
+        s = self._connect(addr)
+        s.sendall(get)
+        first = self.recv_reply(s, server.secret)
+        assert first["trial_id"] == trial.trial_id
+        # Runner reports FINAL; assignment clears server-side.
+        s.sendall(self.frame({"type": "FINAL", "partition_id": 0,
+                              "trial_id": trial.trial_id, "value": 1.0},
+                             server.secret))
+        assert self.recv_reply(s, server.secret)["type"] == "OK"
+        # Replay the captured GET bytes: same authentic frame, stale intent.
+        s.sendall(get)
+        replay = self.recv_reply(s, server.secret)
+        assert replay.get("trial_id") is None, \
+            "replayed GET re-assigned a finalized trial"
+        s.close()
+
+    def test_replayed_final_is_idempotent_at_server(self, opt_server):
+        """A FINAL frame replayed N times clears the same assignment once
+        and never crashes; driver-side dedup (optimization_driver handles a
+        duplicate FINAL by re-arming the runner, not double-recording) gets
+        each copy to judge."""
+        server, driver, addr = opt_server
+        server.reservations.add({"partition_id": 0, "host_port": "x",
+                                 "task_attempt": 0, "trial_id": None})
+        fin = self.frame({"type": "FINAL", "partition_id": 0,
+                          "trial_id": "t1", "value": 2.0}, server.secret)
+        s = self._connect(addr)
+        for _ in range(3):
+            s.sendall(fin)
+            assert self.recv_reply(s, server.secret)["type"] == "OK"
+        assert server.reservations.get_assigned_trial(0) is None
+        s.close()
+
+    def test_replayed_metric_on_dead_trial_is_harmless(self, opt_server):
+        server, driver, addr = opt_server
+        server.reservations.add({"partition_id": 0, "host_port": "x",
+                                 "task_attempt": 0, "trial_id": None})
+        met = self.frame({"type": "METRIC", "partition_id": 0,
+                          "trial_id": "gone", "value": 0.5, "step": 1},
+                         server.secret)
+        s = self._connect(addr)
+        for _ in range(3):
+            s.sendall(met)
+            reply = self.recv_reply(s, server.secret)
+            assert reply["type"] in ("OK", "STOP")
+        s.close()
+
+    def test_garbage_then_valid_client_unaffected(self, opt_server):
+        """A firehose of random bytes on one connection never disturbs a
+        concurrent well-behaved client."""
+        server, driver, addr = opt_server
+        rng = np.random.default_rng(0)
+        bad = self._connect(addr)
+        good = self._connect(addr)
+        reg = self.frame({"type": "REG", "partition_id": 1, "host_port": "g",
+                          "task_attempt": 0}, server.secret)
+        try:
+            bad.sendall(rng.integers(0, 256, size=4096, dtype=np.uint8)
+                        .tobytes())
+        except OSError:
+            pass  # server may RST mid-send; that IS the drop
+        good.sendall(reg)
+        assert self.recv_reply(good, server.secret)["type"] == "OK"
+        bad.close()
+        good.close()
