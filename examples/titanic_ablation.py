@@ -108,7 +108,15 @@ def main():
     data_path = _os.path.join(tempfile.mkdtemp(prefix="titanic_"),
                               "titanic.tfrecord")
     write_dataset_tfrecord(data_path)
-    study = AblationStudy("titanic", 1, "survived", train_set=data_path)
+    # Publish the dataset under a name@version in the dataset registry —
+    # the featurestore workflow: the study then addresses it by name only
+    # (the reference resolved training_dataset_name/version through
+    # Hopsworks, `loco.py:41-80`).
+    from maggy_tpu.train import DatasetRegistry
+
+    version = DatasetRegistry().register(
+        "titanic", data_path, description="synthetic titanic-like tabular")
+    study = AblationStudy("titanic", version, "survived")
     study.features.include(*FEATURES)
     study.model.set_base_model_generator(model_generator)
     study.model.layers.include("hidden_1", "hidden_2")

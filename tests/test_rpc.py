@@ -833,3 +833,100 @@ class TestAdversarialFrames:
         assert self.recv_reply(good, server.secret)["type"] == "OK"
         bad.close()
         good.close()
+
+
+class TestElasticMigration:
+    """Unit pins for the elastic chip-migration rules (the e2e lives in
+    test_experiment.py::TestElasticChipLeasing, slow lane)."""
+
+    @pytest.fixture
+    def edriver(self, tmp_path):
+        from maggy_tpu import OptimizationConfig
+        from maggy_tpu.core.driver.optimization_driver import OptimizationDriver
+        from maggy_tpu.core.environment import EnvSing
+        from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+        from maggy_tpu.searchspace import Searchspace
+
+        EnvSing.set_instance(LocalEnv(base_dir=str(tmp_path / "exp")))
+        config = OptimizationConfig(
+            name="elastic_unit", num_trials=4, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 1.0])),
+            direction="max", num_workers=1, seed=2, es_policy="none",
+            pool="elastic", chips_per_trial=1, total_chips=4,
+            chips_per_budget={1: 1, 9: 4},
+        )
+        drv = OptimizationDriver(config, "app", 0)
+        yield drv
+        drv.stop()
+        EnvSing.reset()
+
+    def _park(self, drv, budget):
+        trial = Trial({"lr": 0.5, "budget": budget})
+        drv._trial_store[trial.trial_id] = trial
+        drv._parked.append(trial.trial_id)
+        return trial
+
+    def test_last_runner_retires_when_respawn_in_flight(self, edriver):
+        """THE consolidation deadlock (2+2 -> 4): the only live runner's
+        chips are needed by an in-flight bigger respawn — it must retire."""
+        self._park(edriver, budget=9)  # needs 4 chips
+        edriver.server.reservations.add({"partition_id": 0, "capacity": 1})
+        edriver._resize_inflight = {4: 1}  # respawn already requested
+        assert edriver._maybe_migrate(0, 1) is True
+        assert edriver.server.reservations.pop_resize(0) == 0  # retire
+
+    def test_uncovered_demand_resizes_not_retires(self, edriver):
+        self._park(edriver, budget=9)
+        edriver.server.reservations.add({"partition_id": 0, "capacity": 1})
+        assert edriver._maybe_migrate(0, 1) is True
+        assert edriver.server.reservations.pop_resize(0) == 4  # grow to demand
+        assert edriver._resize_inflight.get(4) == 1
+        assert 0 in edriver._resize_watch
+
+    def test_runner_matching_demand_stays(self, edriver):
+        self._park(edriver, budget=9)
+        edriver.server.reservations.add({"partition_id": 0, "capacity": 4})
+        assert edriver._maybe_migrate(0, 4) is False
+        assert edriver.server.reservations.pop_resize(0) is None
+
+    def test_periodic_check_kills_spawned_silent_respawn(self, edriver,
+                                                         monkeypatch):
+        from maggy_tpu import constants
+
+        killed = []
+
+        class FakePool:
+            def spawn_age(self, pid):
+                return 999.0  # spawned long ago, never registered
+
+            def kill_worker(self, pid):
+                killed.append(pid)
+                return True
+
+        monkeypatch.setattr(constants, "RESIZE_RESPAWN_TIMEOUT_S", 0.01)
+        edriver._active_pool = FakePool()
+        edriver._resize_inflight = {4: 1}
+        edriver._resize_watch = {1: (time.monotonic() - 10, 4)}
+        edriver.periodic_check()
+        assert killed == [1]
+        assert edriver._resize_watch == {}
+        assert edriver._resize_inflight.get(4) == 0
+
+    def test_periodic_check_rearms_queued_respawn(self, edriver, monkeypatch):
+        from maggy_tpu import constants
+
+        class FakePool:
+            def spawn_age(self, pid):
+                return None  # still queued for chips: healthy waiting
+
+            def kill_worker(self, pid):
+                raise AssertionError("queued respawn must not be killed")
+
+        monkeypatch.setattr(constants, "RESIZE_RESPAWN_TIMEOUT_S", 0.01)
+        edriver._active_pool = FakePool()
+        edriver._resize_inflight = {4: 1}
+        edriver._resize_watch = {1: (time.monotonic() - 10, 4)}
+        edriver.periodic_check()
+        assert 1 in edriver._resize_watch  # re-armed, not expired
+        assert edriver._resize_watch[1][0] > time.monotonic() - 1
+        assert edriver._resize_inflight.get(4) == 1
