@@ -234,7 +234,7 @@ class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, return_hidden=False):
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(
@@ -255,4 +255,10 @@ class Llama(nn.Module):
         head = self.param("lm_head", nn.with_logical_partitioning(
             nn.initializers.normal(0.02), (EMBED, VOCAB)),
             (cfg.hidden_dim, cfg.vocab_size), cfg.param_dtype)
+        if return_hidden:
+            # Pre-head output for the vocab-chunked loss
+            # (ops.losses.chunked_next_token_loss): at 128k vocab the full
+            # [B, S, V] fp32 logits are the largest activation in the
+            # model — the chunked loss never materializes them.
+            return x, head
         return jnp.dot(x, head.astype(cfg.dtype)).astype(jnp.float32)
