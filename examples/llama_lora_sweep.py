@@ -28,10 +28,11 @@ import optax
 
 from maggy_tpu import OptimizationConfig, Searchspace, experiment
 from maggy_tpu.models import Llama, LlamaConfig
+from maggy_tpu.ops.losses import chunked_next_token_loss
 from maggy_tpu.optimizers import Asha
 from maggy_tpu.parallel import make_mesh
 from maggy_tpu.train import Trainer
-from maggy_tpu.train.trainer import next_token_loss
+from maggy_tpu.train.lora import only_lora
 
 VOCAB = 256
 
@@ -51,10 +52,16 @@ def train_fn(lora_rank, lora_alpha, lr, budget=1, reporter=None):
     cfg = LlamaConfig.tiny(vocab_size=VOCAB, lora_rank=int(lora_rank))
     cfg = LlamaConfig(**{**cfg.__dict__, "lora_alpha": float(lora_alpha)})
     model = Llama(cfg)
+    # The flagship recipe: the 8B base stays FROZEN (only_lora masks the
+    # optimizer to the adapters — no moments for 8B of weights) and the
+    # loss is computed vocab-chunked from pre-head activations, never
+    # materializing the [B, S, 128k] logits (ops/losses.py).
     trainer = Trainer(
-        model, optax.adamw(lr),
-        lambda logits, batch: next_token_loss(logits, batch["tokens"]),
+        model, only_lora(optax.adamw(lr)),
+        lambda out, batch: chunked_next_token_loss(
+            out[0], out[1], batch["tokens"], vocab_chunk=128),
         mesh, strategy="fsdp" if n_dev > 1 else "dp",
+        train_kwargs={"return_hidden": True},
     )
     trainer.init(jax.random.key(0), (jnp.ones((1, 16), jnp.int32),))
     steps = int(20 * budget)
