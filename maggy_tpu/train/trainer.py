@@ -276,10 +276,23 @@ class Trainer:
                 self.variables, self.opt_state, batch)
         return loss
 
-    def fit(self, batches, reporter=None, report_every: int = 1) -> float:
+    def fit(self, batches, reporter=None, report_every: int = 1,
+            callbacks=()) -> float:
+        """Step over ``batches``; returns the final loss.
+
+        The per-step loss is broadcast LAZILY (an un-materialized device
+        scalar): `Reporter` pulls it to host on the heartbeat thread, so
+        reporting never serializes the pipelined step stream (a blocking
+        ``float(loss)`` here cost ~50 ms/sync over a tunneled chip —
+        BASELINE.md round-3 diagnosis). ``callbacks`` are `maggy_tpu.
+        callbacks.BatchEnd`-style callables invoked as cb(logs, step) with
+        the same lazy scalar in ``logs["loss"]``.
+        """
         loss = None
         for i, batch in enumerate(batches):
             loss = self.step(self.place_batch(batch))
             if reporter is not None and i % report_every == 0:
-                reporter.broadcast(float(loss), step=i)
+                reporter.broadcast(loss, step=i)
+            for cb in callbacks:
+                cb({"loss": loss}, step=i)
         return float(loss) if loss is not None else float("nan")

@@ -520,3 +520,44 @@ class TestChunkedLoss:
         losses = [float(trainer.step(trainer.place_batch(
             {"inputs": (tokens,), "tokens": tokens}))) for _ in range(5)]
         assert losses[-1] < losses[0]
+
+
+class TestFitReporting:
+    def test_fit_broadcasts_lazy_and_fires_callbacks(self):
+        """fit() must hand the reporter the UN-materialized device scalar
+        (lazy-sync contract, BASELINE.md r3 diagnosis) and invoke BatchEnd
+        callbacks with the same logs."""
+        from maggy_tpu.core.reporter import Reporter
+
+        mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+        model = MnistCNN(kernel_size=3, pool_size=2, features=8,
+                         num_classes=2)
+        trainer = Trainer(
+            model, optax.adam(1e-3),
+            lambda logits, batch: cross_entropy_loss(logits, batch["labels"]),
+            mesh, strategy="dp")
+        trainer.init(jax.random.key(0), (jnp.zeros((1, 8, 8, 1)),))
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(64, 8, 8, 1)).astype(np.float32)
+        y = (X.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+
+        reporter = Reporter()
+        broadcast_types = []
+        orig = reporter.broadcast
+        reporter.broadcast = lambda m, step=None: (
+            broadcast_types.append(type(m)), orig(m, step=step))
+        seen = []
+
+        def cb(logs, step=None):
+            seen.append((step, logs["loss"]))
+
+        def batches():
+            for i in range(0, 64, 32):
+                yield {"inputs": (jnp.asarray(X[i:i + 32]),),
+                       "labels": jnp.asarray(y[i:i + 32])}
+
+        final = trainer.fit(batches(), reporter=reporter, callbacks=[cb])
+        assert np.isfinite(final)
+        # Lazy contract: the reporter received device arrays, not floats.
+        assert broadcast_types and all(t is not float for t in broadcast_types)
+        assert [s for s, _ in seen] == [0, 1]
