@@ -151,3 +151,23 @@ class TestBuildSummary:
         ids = {c["id"] for c in summary["combinations"]}
         assert ids == {"t1", "t2"}
         assert env.exists(exp_dir + "/.summary.json")
+
+
+class TestRegistryOnGCS:
+    def test_register_and_resolve_through_gcs(self, env, tmp_path):
+        """DatasetRegistry must work unchanged on a bucket-backed env:
+        manifests go through the env fs, data paths stay wherever the
+        data lives (here, local npz)."""
+        import numpy as np
+
+        from maggy_tpu.train.registry import DatasetRegistry
+
+        p = str(tmp_path / "d.npz")
+        np.savez(p, x=np.arange(6, dtype=np.float32))
+        reg = DatasetRegistry(env=env)
+        v = reg.register("toy", p, description="bucketed manifest")
+        assert v == 1
+        assert reg.root.startswith("gs://")
+        m = reg.get("toy")
+        assert m["path"] == p and m["schema"] == {"x": "float32"}
+        assert reg.names() == ["toy"] and reg.versions("toy") == [1]
