@@ -31,10 +31,27 @@ RUNNING = False
 RUN_ID = 0
 
 
-def lagom(train_fn: Callable, config: LagomConfig) -> Any:
+def lagom(train_fn: Callable, config: LagomConfig = None, **kwargs) -> Any:
     """Launch an experiment: asynchronous HPO, an ablation study, or
-    distributed training, selected by the config type."""
+    distributed training, selected by the config type.
+
+    Compat: the reference's 0.x notebook style
+    ``lagom(train_fn, searchspace=sp, optimizer="randomsearch",
+    num_trials=15, direction="max")`` (its README quick start) is accepted —
+    keyword arguments build an `OptimizationConfig`."""
     global APP_ID, RUNNING, RUN_ID
+    if config is None:
+        if not kwargs:
+            raise TypeError(
+                "lagom() needs a config object (OptimizationConfig / "
+                "AblationConfig / DistributedConfig) or OptimizationConfig "
+                "keyword arguments.")
+        config = OptimizationConfig(**kwargs)
+    elif kwargs:
+        raise TypeError(
+            "Pass EITHER a config object OR keyword arguments, not both "
+            "(got config={!r} plus {}).".format(
+                type(config).__name__, sorted(kwargs)))
     if RUNNING:
         raise RuntimeError("An experiment is already running in this process.")
     # Honor JAX_PLATFORMS even when a TPU plugin was registered before this

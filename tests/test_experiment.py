@@ -499,3 +499,26 @@ class TestHeartbeatLossE2E:
         assert os.path.exists(os.environ["MAGGY_TEST_WEDGE_FLAG"])
         assert result["num_trials"] == 4
         assert result.get("lost_runners", 0) >= 1
+
+
+class TestLagomKwargsCompat:
+    """The reference's 0.x notebook style: lagom(train_fn, searchspace=...,
+    optimizer=..., ...) builds an OptimizationConfig (docs/migration.md)."""
+
+    def test_kwargs_build_config(self, local_env):
+        result = experiment.lagom(
+            train_quadratic, searchspace=space(), optimizer="randomsearch",
+            num_trials=3, direction="max", num_workers=2, seed=9,
+            es_policy="none", hb_interval=0.05)
+        assert result["num_trials"] == 3
+
+    def test_config_plus_kwargs_rejected(self):
+        with pytest.raises(TypeError, match="not both"):
+            experiment.lagom(
+                train_quadratic,
+                OptimizationConfig(searchspace=space(), num_trials=1),
+                optimizer="randomsearch")
+
+    def test_neither_rejected(self):
+        with pytest.raises(TypeError, match="config object"):
+            experiment.lagom(train_quadratic)
