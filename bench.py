@@ -655,10 +655,27 @@ def _remediate_device():
                     ppid = int(f.read().split(")")[-1].split()[1])
             except (OSError, ValueError, IndexError):
                 continue
-            ours = ("bench.py --headline" in cmd or "bench.py --extra" in cmd
-                    or "maggy_tpu.runner" in cmd
-                    or "multiprocessing.spawn" in cmd and "maggy" in cmd)
-            if ours and ppid == 1:
+            if ppid != 1 or "python" not in cmd:
+                continue
+            # Identify OUR orphans by their INITIAL environment, not their
+            # cmdline: mp-spawn grandchildren run a generic spawn_main
+            # cmdline, while a user's daemonized runner agent (ppid 1 but
+            # alive on purpose) must never match. Every process a bench
+            # run creates inherits MAGGY_TPU_BASE_DIR=<tmp>/bench_* at
+            # exec time, so /proc/<pid>/environ carries the marker.
+            try:
+                with open("/proc/{}/environ".format(pid), "rb") as f:
+                    environ = f.read()
+            except OSError:
+                continue
+            ours = False
+            for entry in environ.split(b"\x00"):
+                if entry.startswith(b"MAGGY_TPU_BASE_DIR="):
+                    base = entry.split(b"=", 1)[1]
+                    ours = os.path.basename(base.decode(
+                        "utf-8", "replace")).startswith("bench_")
+                    break
+            if ours:
                 try:
                     os.kill(pid, signal.SIGKILL)
                     killed.append(pid)
@@ -697,7 +714,12 @@ def _probe_device_with_retry(budget_s):
     window is caught, instead of one early probe deciding the round
     (the r3/r4 failure mode: both artifacts were information-free 0.0s
     from a single probe at an unlucky moment)."""
-    single = float(os.environ.get("BENCH_PROBE_ATTEMPT_S", "75"))
+    # Per-attempt timeout must cover a SLOW-HEALTHY claim (cold tunnel
+    # dial + plugin init can take minutes on a loaded host) — a cap that
+    # only fits the fast case would misclassify a live chip as wedged and
+    # fall back to the proxy. 150 s gives two patient attempts inside the
+    # default 300 s window.
+    single = float(os.environ.get("BENCH_PROBE_ATTEMPT_S", "150"))
     deadline = time.monotonic() + budget_s
     attempt = 0
     while True:

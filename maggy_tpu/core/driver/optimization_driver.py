@@ -392,7 +392,9 @@ class OptimizationDriver(Driver):
                 if demand[size] > supply:
                     self._resize_inflight[size] = \
                         self._resize_inflight.get(size, 0) + 1
-                    self._resize_watch[partition_id] = (time.monotonic(), size)
+                    self._resize_watch[partition_id] = (
+                        time.monotonic(), size, self._pool_spawn_stamp(
+                            partition_id))
                     self.server.reservations.request_resize(partition_id, size)
                     self._log("idle runner {} (capacity {}) resized toward "
                               "waiting work ({} chips)".format(
@@ -415,6 +417,11 @@ class OptimizationDriver(Driver):
                   "for pending resizes".format(partition_id, cap))
         return True
 
+    def _pool_spawn_stamp(self, partition_id: int):
+        pool = getattr(self, "_active_pool", None)
+        stamp_of = getattr(pool, "spawn_stamp", None)
+        return stamp_of(partition_id) if stamp_of is not None else None
+
     def periodic_check(self) -> None:
         """Server event-loop hook: bound resize-respawn registration.
 
@@ -427,24 +434,35 @@ class OptimizationDriver(Driver):
         for chips (kill_worker finds nothing) merely loses its in-flight
         credit — worst case another idle runner re-chases the demand."""
         pool = getattr(self, "_active_pool", None)
-        age_of = getattr(pool, "spawn_age", None)
+        stamp_of = getattr(pool, "spawn_stamp", None)
         now = time.monotonic()
         expired = []
         with self._store_lock:
-            for pid, (t0, size) in list(self._resize_watch.items()):
+            for pid, (t0, size, s0) in list(self._resize_watch.items()):
                 if now - t0 <= constants.RESIZE_RESPAWN_TIMEOUT_S:
                     continue
-                # Only the SPAWNED-but-silent case is pathological. A
-                # respawn still queued for chips (spawn_age None) is
-                # healthy waiting — e.g. behind another runner's
-                # minutes-long trial — so its watch is re-armed, not
-                # expired (expiring it would drop the in-flight credit a
-                # later REGISTER then double-decrements).
-                age = age_of(pid) if age_of is not None else now - t0
-                if age is None:
-                    self._resize_watch[pid] = (now, size)
+                if stamp_of is None:
+                    # No pool visibility: fall back to the request clock.
+                    del self._resize_watch[pid]
+                    if self._resize_inflight.get(size, 0) > 0:
+                        self._resize_inflight[size] -= 1
+                    expired.append((pid, size))
                     continue
-                if age <= constants.RESIZE_RESPAWN_TIMEOUT_S:
+                stamp = stamp_of(pid)
+                # Three healthy states re-arm the watch (expiring any of
+                # them would drop an in-flight credit a later REGISTER
+                # then double-decrements):
+                # - stamp is None: the respawn is QUEUED for chips — e.g.
+                #   waiting behind another runner's minutes-long trial;
+                # - stamp == s0: the PRE-resize process is still winding
+                #   down (it must not be killed for being old — its age
+                #   predates the request by construction);
+                # - a NEW process (stamp != s0) younger than the bound.
+                # Only a post-request process older than the bound is a
+                # wedged respawn.
+                if stamp is None or stamp == s0 or \
+                        now - stamp <= constants.RESIZE_RESPAWN_TIMEOUT_S:
+                    self._resize_watch[pid] = (now, size, s0)
                     continue
                 del self._resize_watch[pid]
                 if self._resize_inflight.get(size, 0) > 0:
@@ -682,7 +700,9 @@ class OptimizationDriver(Driver):
                     # also chase the same trial.
                     self._resize_inflight[need] = \
                         self._resize_inflight.get(need, 0) + 1
-                    self._resize_watch[partition_id] = (time.monotonic(), need)
+                    self._resize_watch[partition_id] = (
+                        time.monotonic(), need, self._pool_spawn_stamp(
+                            partition_id))
                 self.server.reservations.request_resize(partition_id, need)
                 self._log("trial {} needs {} chip(s); runner {} (capacity "
                           "{}) asked to resize".format(

@@ -318,16 +318,25 @@ class ElasticTPURunnerPool(RunnerPool):
         self._free: set = set()
         self._lock = threading.Lock()
 
-    def spawn_age(self, partition_id: int):
-        """Seconds since partition's CURRENT process was spawned, or None
-        when no process exists (respawn still queued for chips). The
-        driver's resize watchdog keys off this: a queued respawn is
-        healthy waiting, only a spawned-but-never-registered process is
-        evidence of a wedged startup."""
+    def spawn_stamp(self, partition_id: int):
+        """Monotonic spawn time of the partition's CURRENT process, or
+        None when no process exists (respawn still queued for chips).
+
+        The driver's resize watchdog compares stamps, not ages: at resize
+        request time the partition still runs its PRE-resize process, so a
+        bare age check would see that (old, long-lived) process and kill a
+        runner that is merely winding down. Only a process spawned AFTER
+        the request (stamp > the stamp recorded at request time) that then
+        fails to register is evidence of a wedged respawn."""
         with self._lock:
             if partition_id not in self._procs:
                 return None
-            t0 = self._spawn_time.get(partition_id)
+            return self._spawn_time.get(partition_id)
+
+    def spawn_age(self, partition_id: int):
+        """Seconds since the partition's CURRENT process was spawned, or
+        None when no process exists."""
+        t0 = self.spawn_stamp(partition_id)
         return None if t0 is None else time.monotonic() - t0
 
     def _resize_file(self, partition_id: int) -> str:

@@ -88,7 +88,17 @@ class DatasetRegistry:
                 datetime.timezone.utc).isoformat(),
         }
         self.env.mkdir(self._dir(name))
-        self.env.dump(json.dumps(manifest, indent=2), mpath)
+        payload = json.dumps(manifest, indent=2)
+        self.env.dump(payload, mpath)
+        # Concurrent registrations of the same name can race the
+        # exists()-then-dump window and pick the same auto-version; the
+        # env's atomic dump makes exactly one writer win, so read back and
+        # make the LOSER fail loudly instead of silently believing its
+        # manifest was recorded.
+        if self.env.load(mpath) != payload:
+            raise ValueError(
+                "{}@{} was registered concurrently by another writer; "
+                "retry to get a fresh version number.".format(name, version))
         return int(version)
 
     # -------------------------------------------------------------- lookup

@@ -204,9 +204,6 @@ class TestProbeRetry:
                 return []
             return real_glob(pattern)
 
-        monkeypatch.setattr(bench.__dict__["glob"]
-                            if "glob" in bench.__dict__ else glob_mod,
-                            "glob", fake_glob) if False else None
         import glob
 
         monkeypatch.setattr(glob, "glob", fake_glob)

@@ -894,10 +894,11 @@ class TestElasticMigration:
         from maggy_tpu import constants
 
         killed = []
+        s0 = time.monotonic() - 1000  # pre-resize process's spawn stamp
 
         class FakePool:
-            def spawn_age(self, pid):
-                return 999.0  # spawned long ago, never registered
+            def spawn_stamp(self, pid):
+                return s0 + 800  # a NEW process, spawned post-request...
 
             def kill_worker(self, pid):
                 killed.append(pid)
@@ -906,8 +907,8 @@ class TestElasticMigration:
         monkeypatch.setattr(constants, "RESIZE_RESPAWN_TIMEOUT_S", 0.01)
         edriver._active_pool = FakePool()
         edriver._resize_inflight = {4: 1}
-        edriver._resize_watch = {1: (time.monotonic() - 10, 4)}
-        edriver.periodic_check()
+        edriver._resize_watch = {1: (time.monotonic() - 10, 4, s0)}
+        edriver.periodic_check()  # ...silent for 200s: wedged -> killed
         assert killed == [1]
         assert edriver._resize_watch == {}
         assert edriver._resize_inflight.get(4) == 0
@@ -916,7 +917,7 @@ class TestElasticMigration:
         from maggy_tpu import constants
 
         class FakePool:
-            def spawn_age(self, pid):
+            def spawn_stamp(self, pid):
                 return None  # still queued for chips: healthy waiting
 
             def kill_worker(self, pid):
@@ -925,8 +926,32 @@ class TestElasticMigration:
         monkeypatch.setattr(constants, "RESIZE_RESPAWN_TIMEOUT_S", 0.01)
         edriver._active_pool = FakePool()
         edriver._resize_inflight = {4: 1}
-        edriver._resize_watch = {1: (time.monotonic() - 10, 4)}
+        edriver._resize_watch = {1: (time.monotonic() - 10, 4, 123.0)}
         edriver.periodic_check()
         assert 1 in edriver._resize_watch  # re-armed, not expired
         assert edriver._resize_watch[1][0] > time.monotonic() - 1
+        assert edriver._resize_inflight.get(4) == 1
+
+    def test_periodic_check_spares_old_process_winding_down(self, edriver,
+                                                            monkeypatch):
+        """The PRE-resize process (stamp == the stamp recorded at request
+        time) is old by definition — it must never be killed for its age
+        while it winds down toward the exit that triggers the respawn."""
+        from maggy_tpu import constants
+
+        s0 = time.monotonic() - 5000
+
+        class FakePool:
+            def spawn_stamp(self, pid):
+                return s0  # STILL the pre-resize process
+
+            def kill_worker(self, pid):
+                raise AssertionError("pre-resize process must not be killed")
+
+        monkeypatch.setattr(constants, "RESIZE_RESPAWN_TIMEOUT_S", 0.01)
+        edriver._active_pool = FakePool()
+        edriver._resize_inflight = {4: 1}
+        edriver._resize_watch = {1: (time.monotonic() - 10, 4, s0)}
+        edriver.periodic_check()
+        assert 1 in edriver._resize_watch
         assert edriver._resize_inflight.get(4) == 1
