@@ -180,12 +180,16 @@ def log(msg):
 
 
 def handoff_gaps(trials):
-    """Per-partition trial hand-off gaps from loaded trial.json dicts:
-    time from one trial's end (start+duration) to the SAME runner's next
-    trial start. This is the control plane's per-trial overhead — the
-    number that must stay in the low milliseconds (BASELINE.md's <50 ms
-    budget). Gaps spanning rung-barrier idle waits are excluded by capping
-    at 2 s (idling on purpose is scheduling, not overhead)."""
+    """FALLBACK hand-off estimator from trial.json dicts (start+duration
+    -> same runner's next start), for experiment dirs that predate the
+    telemetry journal. The artifact of record is now the journal:
+    `scheduling_telemetry` replays <exp_dir>/telemetry.jsonl through
+    `maggy_tpu.telemetry.replay_journal`, whose driver-observed span
+    timestamps ("finalized" -> same partition's next "running") measure
+    the control plane directly instead of reconstructing it. Gaps
+    spanning rung-barrier idle waits are excluded by capping at 2 s
+    (idling on purpose is scheduling, not overhead) — both paths share
+    that rule, so the numbers stay comparable across rounds."""
     by_partition = {}
     for t in trials:
         pid = (t.get("info_dict") or {}).get("partition")
@@ -206,6 +210,29 @@ def handoff_gaps(trials):
     return {"median_ms": round(gaps[len(gaps) // 2], 1),
             "p95_ms": round(gaps[int(len(gaps) * 0.95)], 1),
             "n": len(gaps)}
+
+
+def scheduling_telemetry(exp_dir, trial_dicts):
+    """Hand-off gap + early-stop reaction latency for the detail block,
+    derived from the experiment's telemetry journal. The journal is the
+    reproducibility contract: `maggy_tpu.telemetry.replay_journal` over
+    the SAME file yields the SAME numbers offline, so a BENCH_*.json
+    detail block can be re-derived from the artifact alone. Falls back to
+    the trial.json reconstruction for pre-telemetry experiment dirs."""
+    from maggy_tpu.telemetry import JOURNAL_NAME, replay_journal
+
+    journal = os.path.join(exp_dir, JOURNAL_NAME)
+    if os.path.exists(journal):
+        derived = replay_journal(journal)
+        return {
+            "handoff": derived.get("handoff") or {},
+            "early_stop_reaction": derived.get("early_stop_reaction") or {},
+            "source": "telemetry_journal",
+            "journal": journal,
+        }
+    return {"handoff": handoff_gaps(trial_dicts),
+            "early_stop_reaction": {},
+            "source": "trial_json_fallback"}
 
 
 # ------------------------------------------------------------- MFU + kernels
@@ -431,7 +458,11 @@ def _force_cpu_if_requested():
 def headline_main():
     """Child process: warm-up, framework sweep, stage-based baselines.
     Prints the headline JSON line (no extras) on stdout."""
-    os.environ.setdefault("MAGGY_TPU_BASE_DIR", tempfile.mkdtemp(prefix="bench_"))
+    # NOT setdefault(k, mkdtemp()): the fallback arg evaluates eagerly, so
+    # every child spawned by the orchestrator (which already exported the
+    # shared base dir) would mint and abandon an empty /tmp/bench_* dir.
+    if "MAGGY_TPU_BASE_DIR" not in os.environ:
+        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
     _force_cpu_if_requested()
     from maggy_tpu.util import enable_compile_cache
 
@@ -479,10 +510,17 @@ def headline_main():
     rung_schedule = {}
     for _, rung, lr, batch, budget in schedule:
         rung_schedule.setdefault(rung, []).append((lr, batch, budget))
-    handoff = handoff_gaps(trial_dicts)
+    sched = scheduling_telemetry(exp_dirs[-1], trial_dicts)
+    handoff = sched["handoff"]
     if handoff:
-        log("hand-off gap ms: median {} p95 {} (n={})".format(
-            handoff["median_ms"], handoff["p95_ms"], handoff["n"]))
+        log("hand-off gap ms ({}): median {} p95 {} (n={})".format(
+            sched["source"], handoff["median_ms"], handoff["p95_ms"],
+            handoff["n"]))
+    if sched["early_stop_reaction"]:
+        log("early-stop reaction ms: median {} p95 {} (n={})".format(
+            sched["early_stop_reaction"]["median_ms"],
+            sched["early_stop_reaction"]["p95_ms"],
+            sched["early_stop_reaction"]["n"]))
 
     # Two interleaved runs per baseline, keeping each baseline's MIN wall:
     # sustained-load drift (host thermal/noisy-neighbor — measured +12%
@@ -514,6 +552,8 @@ def headline_main():
             "trials": n_runs,
             "early_stopped": result.get("early_stopped", 0),
             "handoff": handoff,
+            "early_stop_reaction": sched["early_stop_reaction"],
+            "handoff_source": sched["source"],
         },
     }), flush=True)
     return 0
@@ -628,6 +668,87 @@ def _probe_device(timeout_s):
         return False
 
 
+def _proc_starttime(pid):
+    """The kernel's process start time (clock ticks since boot; stat
+    field 22) — with the pid it uniquely identifies ONE process incarnation,
+    which is what makes the owner-liveness check immune to pid reuse."""
+    with open("/proc/{}/stat".format(pid)) as f:
+        stat = f.read()
+    return stat.rsplit(")", 1)[1].split()[19]
+
+
+def _mint_base_dir():
+    """Create this run's bench tmpdir and record our (pid, starttime) as
+    its OWNER (.bench_owner): remediation in later runs uses it to tell a
+    crashed run's leftovers (owner gone — killable) from a live concurrent
+    run's winding-down children (owner alive — hands off), covering the
+    SIGKILL/OOM case the atexit cleanup cannot."""
+    base = tempfile.mkdtemp(prefix="bench_")
+    try:
+        pid = os.getpid()
+        with open(os.path.join(base, ".bench_owner"), "w") as f:
+            f.write("{} {}".format(pid, _proc_starttime(pid)))
+    except OSError:
+        pass
+    return base
+
+
+def _owner_is_dead(base):
+    """True only when the run that minted ``base`` is POSITIVELY over:
+    the recorded owner (pid, starttime) no longer exists. A recycled pid
+    shows a different starttime, so it reads as dead rather than
+    resurrecting the claim (NOT the owner's environ — /proc environ is
+    frozen at exec time and never reflects the os.environ assignment the
+    orchestrator makes). Missing/unreadable owner records and permission
+    errors stay conservative (False = assume live)."""
+    try:
+        with open(os.path.join(base, ".bench_owner")) as f:
+            fields = f.read().split()
+        pid, started = int(fields[0]), fields[1]
+    except (OSError, ValueError, IndexError):
+        return False
+    try:
+        return _proc_starttime(pid) != started
+    except FileNotFoundError:
+        return True  # no such process: the owner is gone
+    except (OSError, IndexError):
+        return False
+
+
+def _marker_base_dir(environ: bytes):
+    """The MAGGY_TPU_BASE_DIR value from a /proc/<pid>/environ blob, or
+    None. The INITIAL environment is the marker of record: mp-spawn
+    grandchildren run a generic cmdline but inherit the base dir at exec
+    time."""
+    for entry in environ.split(b"\x00"):
+        if entry.startswith(b"MAGGY_TPU_BASE_DIR="):
+            return entry.split(b"=", 1)[1].decode("utf-8", "replace")
+    return None
+
+
+def _is_killable_orphan_marker(base, my_base=None):
+    """Kill decision for an init-reparented python with a bench marker.
+
+    A bench_ marker alone is not a death warrant: a CONCURRENT bench run's
+    winding-down children are init-reparented during the normal mp-spawn
+    teardown window and must never be killed. Killable requires the
+    marker to name a bench_ tmpdir that is NOT this process's own run,
+    plus positive evidence that run is OVER: its dir is gone from disk
+    (the orchestrator removes its tmpdir at clean exit, see main()), or
+    the dir remains — a SIGKILLed/OOM-killed run never reaches atexit —
+    but the owner pid it recorded (.bench_owner) is dead. A live
+    concurrent run fails both tests and is left alone."""
+    if not base or not os.path.basename(base).startswith("bench_"):
+        return False
+    if my_base is None:
+        my_base = os.environ.get("MAGGY_TPU_BASE_DIR", "")
+    if base == my_base:
+        return False
+    if not os.path.isdir(base):
+        return True
+    return _owner_is_dead(base)
+
+
 def _remediate_device():
     """Best-effort cleanup of stale-claim causes THIS repo's own runs can
     create, between probe attempts. Two known sources (BASELINE.md, the
@@ -668,14 +789,7 @@ def _remediate_device():
                     environ = f.read()
             except OSError:
                 continue
-            ours = False
-            for entry in environ.split(b"\x00"):
-                if entry.startswith(b"MAGGY_TPU_BASE_DIR="):
-                    base = entry.split(b"=", 1)[1]
-                    ours = os.path.basename(base.decode(
-                        "utf-8", "replace")).startswith("bench_")
-                    break
-            if ours:
+            if _is_killable_orphan_marker(_marker_base_dir(environ)):
                 try:
                     os.kill(pid, signal.SIGKILL)
                     killed.append(pid)
@@ -753,8 +867,18 @@ def main():
 
     A consumer taking either the first or the last JSON line gets the same
     headline numbers."""
-    # Share one base dir + compile cache across children.
-    os.environ.setdefault("MAGGY_TPU_BASE_DIR", tempfile.mkdtemp(prefix="bench_"))
+    # Share one base dir + compile cache across children. When WE mint the
+    # tmpdir, remove it at exit: its absence is the signal a later run's
+    # orphan remediation uses to tell "that run is over, kill its
+    # leftovers" from "live concurrent run, hands off" (see
+    # _is_killable_orphan_marker).
+    if "MAGGY_TPU_BASE_DIR" not in os.environ:
+        import atexit
+        import shutil
+
+        base = _mint_base_dir()
+        os.environ["MAGGY_TPU_BASE_DIR"] = base
+        atexit.register(shutil.rmtree, base, True)
 
     # A CPU-pinned invocation (JAX_PLATFORMS=cpu rehearsal) must not let the
     # children's sitecustomize dial the accelerator tunnel at interpreter

@@ -27,6 +27,12 @@ class LagomConfig:
     #: Print a live progress line while the experiment runs (the reference
     #: streams a progress bar to Jupyter, `util.py:71-86`).
     verbose: bool = False
+    #: Unified telemetry (maggy_tpu.telemetry): trial-span tracing, metric
+    #: registry, and the <exp_dir>/telemetry.jsonl journal the TELEM RPC
+    #: verb / `monitor --telem` / bench.py read. Record paths are
+    #: buffer-only (journal writes happen on a background flusher), so the
+    #: default-on cost on the message hot path is a few dict ops.
+    telemetry: bool = True
 
 
 @dataclass

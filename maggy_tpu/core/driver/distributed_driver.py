@@ -105,6 +105,8 @@ class DistributedDriver(Driver):
 
     def _final_msg_callback(self, msg) -> None:
         self.add_executor_logs(msg.get("logs"))
+        self.telemetry.metrics.counter(
+            "dist.finals.error" if msg.get("error") else "dist.finals.ok").inc()
         with self._results_lock:
             self._finals += 1
             # Fail fast on the FIRST errored rank: a failed worker dooms the

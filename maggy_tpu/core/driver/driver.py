@@ -57,6 +57,29 @@ class Driver(ABC):
             base_dir=getattr(config, "experiment_dir", None),
         )
         self.log_file = None
+        # Unified telemetry: metrics registry + trial spans + JSONL journal
+        # under the experiment dir. The server exposes it via the TELEM
+        # verb and times its verbs through it; record paths are buffer-only
+        # (the journal flushes on its own daemon thread), so attaching it
+        # costs the message hot path no I/O.
+        from maggy_tpu.telemetry import JOURNAL_NAME, Telemetry
+
+        self.telemetry = Telemetry(
+            env=self.env, journal_path=self.exp_dir + "/" + JOURNAL_NAME,
+            enabled=getattr(config, "telemetry", True))
+        self.server.telemetry = self.telemetry
+        if getattr(config, "resume", False):
+            # One continuous journal across interruptions: replaying it
+            # must cover the whole logical experiment, not just this
+            # process's lifetime.
+            restored = 0
+            if self.telemetry.journal is not None:
+                restored = self.telemetry.journal.load_existing()
+            self.telemetry.event("experiment", phase="resumed",
+                                 restored_events=restored)
+        self.telemetry.event("experiment", phase="start", name=self.name,
+                             driver=type(self).__name__, app_id=app_id,
+                             run_id=run_id)
         self._register_msg_callbacks()
 
     # ------------------------------------------------------------- template
@@ -175,6 +198,8 @@ class Driver(ABC):
         if self._worker_thread is not None:
             self._worker_thread.join(timeout=5)
         self.server.stop()
+        self.telemetry.event("experiment", phase="end")
+        self.telemetry.close()
 
     # ------------------------------------------------------------- services
 

@@ -315,15 +315,32 @@ class OptimizationDriver(Driver):
         appended = trial.append_metric(msg["value"], msg.get("step"))
         if not appended:
             return
+        with trial.lock:
+            n_steps = len(trial.step_history)
+        if n_steps == 1:
+            # Scheduling pipeline milestone: time-to-first-signal. The
+            # span's running->first_metric delta is the trial's
+            # startup/compile cost as the control plane sees it.
+            self.telemetry.trial_event(trial.trial_id, "first_metric",
+                                       partition=msg.get("partition_id"))
         with self._store_lock:
             n_final = len(self._final_store)
-        if n_final >= self.es_min and len(trial.step_history) % self.es_interval == 0:
+        if n_final >= self.es_min and n_steps % self.es_interval == 0:
             stopped = self.earlystop_check.earlystop_check(
                 {trial.trial_id: trial}, list(self._final_store), self.direction
             )
             for t in stopped:
+                # The rule can re-return an already-flagged trial (its
+                # heartbeats keep appending metrics until the STOP reply
+                # lands) — counting it again inflated early_stopped vs the
+                # distinct-trial truth the telemetry journal exposes.
+                if t.get_early_stop():
+                    continue
                 t.set_early_stop()
                 self.result["early_stopped"] += 1
+                # Opening edge of the early-stop reaction latency: the
+                # closing edge is this trial's "finalized".
+                self.telemetry.trial_event(t.trial_id, "stop_flagged")
 
     def _blacklist_msg_callback(self, msg) -> None:
         """Executor died and re-registered: requeue its trial (reference
@@ -332,6 +349,9 @@ class OptimizationDriver(Driver):
         if trial is not None:
             trial.reset_run_state()
             self.server.reservations.assign_trial(msg["partition_id"], trial.trial_id)
+            self.telemetry.trial_event(trial.trial_id, "assigned",
+                                       partition=msg["partition_id"],
+                                       requeue="blacklist")
             self._log("executor {} restarted; trial {} requeued".format(
                 msg["partition_id"], msg["trial_id"]))
 
@@ -347,6 +367,8 @@ class OptimizationDriver(Driver):
         with self._store_lock:
             if trial.trial_id not in self._requeue:
                 self._requeue.append(trial.trial_id)
+        self.telemetry.trial_event(trial.trial_id, "lost",
+                                   partition=msg.get("partition_id"))
         self.result["lost_runners"] = self.result.get("lost_runners", 0) + 1
         self._log("runner {} heartbeat lost; trial {} requeued for reassignment".format(
             msg["partition_id"], msg["trial_id"]))
@@ -446,32 +468,50 @@ class OptimizationDriver(Driver):
                     del self._resize_watch[pid]
                     if self._resize_inflight.get(size, 0) > 0:
                         self._resize_inflight[size] -= 1
-                    expired.append((pid, size))
+                    expired.append((pid, size, "timed out (no pool "
+                                               "visibility); killing it"))
                     continue
                 stamp = stamp_of(pid)
                 # Three healthy states re-arm the watch (expiring any of
                 # them would drop an in-flight credit a later REGISTER
                 # then double-decrements):
-                # - stamp is None: the respawn is QUEUED for chips — e.g.
-                #   waiting behind another runner's minutes-long trial;
+                # - stamp is None AND the pool still holds a pending
+                #   respawn: QUEUED for chips — e.g. waiting behind
+                #   another runner's minutes-long trial. stamp None
+                #   WITHOUT a pending respawn means the process died (or
+                #   crashed at spawn) before registering — nothing will
+                #   ever register, so re-arming would leak the in-flight
+                #   credit forever (and the stale credit would keep
+                #   satisfying the last-runner-retire exemption);
                 # - stamp == s0: the PRE-resize process is still winding
                 #   down (it must not be killed for being old — its age
                 #   predates the request by construction);
                 # - a NEW process (stamp != s0) younger than the bound.
                 # Only a post-request process older than the bound is a
                 # wedged respawn.
-                if stamp is None or stamp == s0 or \
+                if stamp is None:
+                    pending_of = getattr(pool, "pending_respawn", None)
+                    if pending_of is None or pending_of(pid):
+                        self._resize_watch[pid] = (now, size, s0)
+                        continue
+                    del self._resize_watch[pid]
+                    if self._resize_inflight.get(size, 0) > 0:
+                        self._resize_inflight[size] -= 1
+                    expired.append((pid, size, "died before registering"))
+                    continue
+                if stamp == s0 or \
                         now - stamp <= constants.RESIZE_RESPAWN_TIMEOUT_S:
                     self._resize_watch[pid] = (now, size, s0)
                     continue
                 del self._resize_watch[pid]
                 if self._resize_inflight.get(size, 0) > 0:
                     self._resize_inflight[size] -= 1
-                expired.append((pid, size))
-        for pid, size in expired:
-            self._log("resize respawn for runner {} ({} chips) spawned but "
-                      "did not re-register within {:.0f}s; killing it".format(
-                          pid, size, constants.RESIZE_RESPAWN_TIMEOUT_S))
+                expired.append((pid, size, "spawned but did not re-register "
+                                           "within {:.0f}s; killing it".format(
+                                               constants.RESIZE_RESPAWN_TIMEOUT_S)))
+        for pid, size, why in expired:
+            self._log("resize respawn for runner {} ({} chips) {}".format(
+                pid, size, why))
             if pool is not None:
                 pool.kill_worker(pid)
 
@@ -525,6 +565,15 @@ class OptimizationDriver(Driver):
                 trial.status = Trial.FINALIZED
                 trial.final_metric = float(msg["value"])
             trial.duration = time.time() - trial.start if trial.start else None
+            was_error = trial.status == Trial.ERROR
+            was_early_stop = trial.early_stop
+        # "finalized": the hand-off gap's opening edge and the early-stop
+        # reaction's closing edge — journaled BEFORE _assign_next so the
+        # journal's event order matches the control flow it measures.
+        self.telemetry.trial_event(trial.trial_id, "finalized",
+                                   partition=msg.get("partition_id"),
+                                   early_stop=was_early_stop,
+                                   error=was_error)
         with self._store_lock:
             self._trial_store.pop(trial.trial_id, None)
             self._final_store.append(trial)
@@ -610,6 +659,7 @@ class OptimizationDriver(Driver):
             # The controller has seen the FINAL; route any fresh suggestion
             # to the requeue for a live runner instead of this one.
             if suggestion not in (None, "IDLE"):
+                self._mint_span(suggestion)
                 with self._store_lock:
                     self._trial_store[suggestion.trial_id] = suggestion
                     self._requeue.append(suggestion.trial_id)
@@ -626,10 +676,20 @@ class OptimizationDriver(Driver):
             if parked is not None:
                 parked.set_status(Trial.SCHEDULED)
                 self.server.reservations.assign_trial(partition_id, parked.trial_id)
+                self.telemetry.trial_event(parked.trial_id, "assigned",
+                                           partition=partition_id,
+                                           requeue="parked")
                 return
             requeued = self._pop_requeue(cap)
             if requeued is not None:
                 self.server.reservations.assign_trial(partition_id, requeued.trial_id)
+                # Neutral label: the backlog holds genuinely lost trials
+                # AND fresh suggestions rerouted off dead partitions — a
+                # lost trial is identifiable by its own "lost" phase
+                # event, so don't stamp phantom losses here.
+                self.telemetry.trial_event(requeued.trial_id, "assigned",
+                                           partition=partition_id,
+                                           requeue="backlog")
                 return
             if last_trial is None:
                 suggestion = self.controller.get_suggestion(None)
@@ -663,6 +723,7 @@ class OptimizationDriver(Driver):
             # processing by ~0.6 s per cycle otherwise).
             self._rearm_idle(partition_id)
         elif suggestion is not None:
+            self._mint_span(suggestion)
             with self._store_lock:
                 # Trial ids hash the params; a controller emitting two
                 # distinct units of work with identical params silently
@@ -710,6 +771,18 @@ class OptimizationDriver(Driver):
                 return
             suggestion.set_status(Trial.SCHEDULED)
             self.server.reservations.assign_trial(partition_id, suggestion.trial_id)
+            self.telemetry.trial_event(suggestion.trial_id, "assigned",
+                                       partition=partition_id)
+
+    def _mint_span(self, trial: Trial) -> None:
+        """Mint the trial's telemetry span when the driver commits to it
+        ("queued") and plant the span id in its info_dict — the TRIAL reply
+        ships info, so the span travels to the runner for free and comes
+        back on its METRIC/FINAL messages."""
+        span = self.telemetry.trial_event(trial.trial_id, "queued")
+        if span is not None:
+            with trial.lock:
+                trial.info_dict["span"] = span
 
     # -------------------------------------------------------------- results
 
@@ -749,6 +822,20 @@ class OptimizationDriver(Driver):
         self.maggy_log = self._result_summary(duration)
         if getattr(self.config, "verbose", False):
             print(self.maggy_log, flush=True)
+        # Make the telemetry artifact durable at the finish line (the
+        # flusher thread's cadence must not decide whether the last trials'
+        # spans land), and mirror the derived scheduling numbers into
+        # TensorBoard scalars next to the experiment's hparams config.
+        self.telemetry.event("experiment", phase="finalized",
+                             duration_s=duration)
+        self.telemetry.flush()
+        try:
+            from maggy_tpu import tensorboard as tb
+
+            tb.write_telemetry_scalars(self.exp_dir,
+                                       self.telemetry.snapshot(fresh=True))
+        except Exception:  # noqa: BLE001 - telemetry mirrors are best-effort
+            pass
         self.env.finalize_experiment(
             self.exp_dir, "FINISHED",
             {"result": {k: self.result[k] for k in

@@ -32,6 +32,18 @@ class AbstractEnv(ABC):
     def dump(self, data: str, path: str) -> None:
         raise NotImplementedError
 
+    def exclusive_create(self, data: str, path: str) -> bool:
+        """Create ``path`` with ``data`` ONLY if it does not already exist;
+        returns False when another writer got there first. This is the
+        lost-update-proof primitive concurrent registrations need — dump()'s
+        atomicity prevents torn files, not last-writer-wins. Default is a
+        best-effort exists+dump (still TOCTOU-prone); LocalEnv and GCSEnv
+        override with real exclusive primitives."""
+        if self.exists(path):
+            return False
+        self.dump(data, path)
+        return True
+
     def load(self, path: str) -> str:
         raise NotImplementedError
 
@@ -132,6 +144,42 @@ class LocalEnv(AbstractEnv):
             except OSError:
                 pass
             raise
+
+    def exclusive_create(self, data: str, path: str) -> bool:
+        # Write a private tmp file fully, then os.link() it into place:
+        # link is BOTH exclusive (EEXIST when the target exists — the
+        # kernel arbitrates, exactly one of N concurrent creators wins,
+        # unlike dump()'s os.replace which silently overwrites) AND
+        # atomic (the target is complete-or-absent; a kill mid-write can
+        # never leave a torn file squatting on the slot the way a direct
+        # O_CREAT|O_EXCL write could).
+        import threading
+
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = "{}.tmp.{}.{}".format(path, os.getpid(), threading.get_ident())
+        try:
+            with open(tmp, "w") as f:
+                f.write(data)
+            try:
+                os.link(tmp, path)
+            except FileExistsError:
+                return False
+            except OSError:
+                # Filesystem without hard links: fall back to O_EXCL (still
+                # exclusive; torn-file window accepted on such fs only).
+                try:
+                    fd = os.open(path,
+                                 os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+                except FileExistsError:
+                    return False
+                with os.fdopen(fd, "w") as f:
+                    f.write(data)
+            return True
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
     def sweep_tmp_files(self, path: str, grace_s: float = 120.0) -> int:
         """Remove orphaned atomic-dump tmp files ('<name>.tmp.<pid>.<tid>')
@@ -241,6 +289,35 @@ class GCSEnv(LocalEnv):
         # exists on GCS anyway. sweep_tmp_files() stays the base no-op.
         with self.fs.open(path, "w") as f:
             f.write(data)
+
+    def exclusive_create(self, data: str, path: str) -> bool:
+        # if_generation_match=0 is GCS's server-side O_CREAT|O_EXCL: the
+        # write commits only if no generation (object) exists, so exactly
+        # one concurrent creator wins. Backends without precondition
+        # support (fsspec's memory fs in tests) silently ignore the kwarg,
+        # which is why the exists() pre-check stays: best-effort there,
+        # bulletproof on real gcsfs.
+        if self.fs.exists(path):
+            return False
+        try:
+            with self.fs.open(path, "w", if_generation_match=0) as f:
+                f.write(data)
+        except FileExistsError:
+            return False
+        except (OSError, ValueError) as e:
+            # gcsfs surfaces the 412 precondition failure in several
+            # shapes; "generation"/"precondition" in the message means we
+            # LOST the race, anything else is a real I/O error.
+            msg = str(e).lower()
+            if "generation" in msg or "precondition" in msg or "412" in msg:
+                return False
+            raise
+        except TypeError:
+            # fs rejects the precondition kwarg outright: plain write
+            # guarded only by the exists() check above.
+            with self.fs.open(path, "w") as f:
+                f.write(data)
+        return True
 
     def load(self, path: str) -> str:
         with self.fs.open(path, "r") as f:

@@ -68,8 +68,15 @@ class DistExecutor:
             host = env.get_ip_address()
             client.register(host_port="{}:{}".format(host, coord_port))
             client.start_heartbeat(reporter)
+            import time as _time
+
+            t_barrier = _time.monotonic()
             client.await_reservations()
             dist_config = client.get_dist_config()
+            # Registration-barrier + coordinator-rendezvous latency, as the
+            # WORKER saw it; shipped on FINAL so the driver's telemetry can
+            # histogram world bring-up without instrumenting each host.
+            rendezvous_ms = (_time.monotonic() - t_barrier) * 1e3
 
             sharding_env = self._init_cluster(dist_config, partition_id, reporter)
             if self.profile:
@@ -80,7 +87,9 @@ class DistExecutor:
                     metric = self._run_train_fn(sharding_env, reporter)
             else:
                 metric = self._run_train_fn(sharding_env, reporter)
-            client.finalize_metric(metric, reporter)
+            client.finalize_metric(
+                metric, reporter,
+                extra={"telem": {"rendezvous_ms": round(rendezvous_ms, 3)}})
         except Exception:  # noqa: BLE001
             reporter.log("Distributed worker {} failed:\n{}".format(
                 partition_id, traceback.format_exc()))

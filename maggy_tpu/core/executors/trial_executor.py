@@ -143,7 +143,11 @@ class TrialExecutor:
                 trial_dir = "{}/{}".format(exp_dir, trial_id)
                 env.mkdir(trial_dir)
                 env.dump(util.json_dumps_safe(params), trial_dir + "/.hparams.json")
-                reporter.reset(trial_id=trial_id)
+                # The driver-minted telemetry span rides the TRIAL info;
+                # arming the reporter with it makes every METRIC/FINAL this
+                # trial sends attributable to its span timeline.
+                reporter.reset(trial_id=trial_id,
+                               span=client.last_info.get("span"))
                 try:
                     # Per-trial TensorBoard logdir + hparams record
                     # (reference `trial_executor.py:122-133`).

@@ -24,6 +24,10 @@ class Reporter:
         self.metric: Optional[float] = None
         self.step: Optional[int] = None
         self.trial_id: Optional[str] = None
+        # Telemetry span id assigned by the driver for this trial; rides
+        # the TRIAL reply and is echoed on METRIC/FINAL so driver-side
+        # span timelines attribute every hop without guessing.
+        self.span: Optional[str] = None
         self._stop_flag = False
         self._log_buffer: List[str] = []
         self._log_file = log_file
@@ -112,6 +116,7 @@ class Reporter:
     def get_data(self) -> Dict[str, Any]:
         with self.lock:
             metric, step, tid = self.metric, self.step, self.trial_id
+            span = self.span
             cached = self._metric_cache
         if metric is not None and not isinstance(metric, float):
             # Materialize OUTSIDE the lock: the device sync (~50 ms over a
@@ -154,10 +159,11 @@ class Reporter:
         with self.lock:
             logs = self._log_buffer
             self._log_buffer = []
-        # trial_id is the one the (metric, step) pair belongs to — callers
-        # must ship THIS id, not re-read reporter.trial_id (which may have
-        # rolled over to the next trial mid-call).
-        return {"metric": metric, "step": step, "logs": logs, "trial_id": tid}
+        # trial_id/span are the ones the (metric, step) pair belongs to —
+        # callers must ship THESE, not re-read reporter fields (which may
+        # have rolled over to the next trial mid-call).
+        return {"metric": metric, "step": step, "logs": logs,
+                "trial_id": tid, "span": span}
 
     def early_stop(self, trial_id: Optional[str] = None) -> None:
         """Arm the stop flag (only once a metric exists, reference
@@ -170,12 +176,14 @@ class Reporter:
             if self.metric is not None:
                 self._stop_flag = True
 
-    def reset(self, trial_id: Optional[str] = None) -> None:
+    def reset(self, trial_id: Optional[str] = None,
+              span: Optional[str] = None) -> None:
         with self.lock:
             self.metric = None
             self.step = None
             self._stop_flag = False
             self._log_buffer = []
             self.trial_id = trial_id
+            self.span = span
             self._metric_cache = None
             self._async_kick = None

@@ -233,6 +233,10 @@ class Server:
 
     def __init__(self, num_executors: int, secret: Optional[str] = None):
         self.num_executors = num_executors
+        # Telemetry facade (maggy_tpu.telemetry.Telemetry), attached by the
+        # driver. None = no TELEM verb, no verb timing. Handlers must treat
+        # it as optional: the server also runs driverless in tests.
+        self.telemetry = None
         # One-shot flag so a broken periodic_check hook logs ONCE instead of
         # spamming (or silently dying) on every event-loop tick.
         self._periodic_check_failed = False
@@ -266,6 +270,19 @@ class Server:
             "done": self.reservations.done(),
         }
         self._handlers["JOIN"] = self._join
+        self._handlers["TELEM"] = self._telem
+
+    def _telem(self, msg):
+        """Telemetry snapshot: live metric registry + span-derived
+        scheduling numbers. Same auth as every verb (per-message HMAC —
+        an unauthenticated peer never reaches this handler); consumed by
+        ``maggy_tpu.monitor --telem`` from any machine that can reach the
+        control plane."""
+        telem = self.telemetry
+        if telem is None:
+            return {"type": "ERR",
+                    "error": "telemetry is not enabled for this experiment"}
+        return {"type": "TELEM", **telem.snapshot()}
 
     def _join(self, msg):
         """Admit a remote runner agent: assign it a partition id and ship
@@ -393,7 +410,16 @@ class Server:
             if handler is None:
                 resp = {"type": "ERR", "error": "unknown message type"}
             else:
+                t0 = time.monotonic()
                 resp = handler(msg)
+                telem = self.telemetry
+                if telem is not None:
+                    # Per-verb server-side service time. Buffer-only
+                    # recording (telemetry journals never write on this
+                    # thread), so the event loop stays I/O-free.
+                    telem.observe_ms(
+                        "rpc.handle_ms.{}".format(msg.get("type")),
+                        (time.monotonic() - t0) * 1e3)
         except (ConnectionError, socket.timeout, OSError):
             self._drop(conn)
             return
@@ -529,6 +555,12 @@ class OptimizationServer(Server):
             self.driver.enqueue({"type": "REG",
                                  "partition_id": msg["partition_id"],
                                  "capacity": msg.get("capacity")})
+        telem = self.telemetry
+        if telem is not None:
+            telem.event("runner", phase="registered",
+                        partition=int(msg["partition_id"]),
+                        capacity=msg.get("capacity"),
+                        reregistration=prev is not None)
         return {"type": "OK"}
 
     def _metric(self, msg):
@@ -539,7 +571,20 @@ class OptimizationServer(Server):
         if trial_id:
             trial = self.driver.get_trial(trial_id)
             stop = bool(trial and trial.get_early_stop())
-        return {"type": "STOP"} if stop else {"type": "OK"}
+        if stop:
+            # The moment the runner is FIRST told to stop: early-stop
+            # reaction latency (stop_flagged -> finalized) brackets this
+            # hop. once=True — heartbeats keep drawing STOP replies until
+            # the training loop honors the flag, and re-journaling each
+            # would bloat the journal by heartbeat rate x stop latency.
+            # The STOP reply echoes the span so the runner side can
+            # attribute the abort without re-deriving it.
+            telem = self.telemetry
+            if telem is not None:
+                telem.trial_event(trial_id, "stop_sent", once=True,
+                                  partition=int(msg["partition_id"]))
+            return {"type": "STOP", "span": msg.get("span")}
+        return {"type": "OK"}
 
     def _final(self, msg):
         self.reservations.touch(msg["partition_id"])
@@ -575,8 +620,16 @@ class OptimizationServer(Server):
         with trial.lock:
             trial.info_dict["partition"] = msg["partition_id"]
             info = dict(trial.info_dict)
+        telem = self.telemetry
+        if telem is not None:
+            # "running" = the TRIAL reply leaves the driver: the hand-off
+            # gap's closing edge (its opening edge is the previous trial's
+            # "finalized" on the same partition).
+            telem.trial_event(trial.trial_id, "running",
+                              partition=int(msg["partition_id"]))
         return {"type": "TRIAL", "trial_id": trial.trial_id,
-                "params": trial.params, "info": info}
+                "params": trial.params, "info": info,
+                "span": info.get("span")}
 
     def _log(self, msg):
         return {"type": "LOG", **self.driver.progress_snapshot()}
@@ -611,6 +664,10 @@ class DistributedServer(Server):
             {"partition_id": msg["partition_id"], "host_port": msg.get("host_port"),
              "task_attempt": msg.get("task_attempt", 0), "trial_id": None}
         )
+        telem = self.telemetry
+        if telem is not None:
+            telem.event("worker", phase="registered",
+                        partition=int(msg["partition_id"]))
         return {"type": "OK"}
 
     def _metric(self, msg):
@@ -626,6 +683,17 @@ class DistributedServer(Server):
         self.reservations.mark_released(msg["partition_id"])
         if self.driver is not None:
             self.driver.enqueue(dict(msg))
+        telem = self.telemetry
+        if telem is not None:
+            telem.event("worker", phase="finalized",
+                        partition=int(msg["partition_id"]),
+                        error=bool(msg.get("error")))
+            # Worker-measured rendezvous latency rides the FINAL payload
+            # (the dist analogue of a trial span's phase timestamps).
+            stats = msg.get("telem") or {}
+            if stats.get("rendezvous_ms") is not None:
+                telem.observe_ms("dist.rendezvous_ms",
+                                 float(stats["rendezvous_ms"]))
         return {"type": "OK"}
 
     def _tick(self) -> None:
@@ -763,7 +831,10 @@ class Client:
                     resp = self._request(
                         {"type": "METRIC", "trial_id": sent_tid,
                          "value": data["metric"], "step": data["step"],
-                         "logs": data["logs"]},
+                         "logs": data["logs"],
+                         # The span the (metric, step) pair belongs to —
+                         # same rollover rule as sent_tid.
+                         "span": data.get("span")},
                         sock=self._hb_sock, lock=False,
                     )
                     if resp.get("type") == "STOP":
@@ -820,14 +891,17 @@ class Client:
             time.sleep(0.5)
         raise TimeoutError("Coordinator rendezvous timed out.")
 
-    def finalize_metric(self, metric, reporter) -> None:
+    def finalize_metric(self, metric, reporter,
+                        extra: Optional[Dict[str, Any]] = None) -> None:
         """Send FINAL and reset the reporter atomically under its lock
-        (reference `rpc.py:584-593`)."""
+        (reference `rpc.py:584-593`). ``extra`` merges additional payload
+        fields (e.g. a dist worker's telemetry stats)."""
         with reporter.lock:
             data = reporter.get_data()
             self._request(
                 {"type": "FINAL", "trial_id": reporter.trial_id,
-                 "value": metric, "logs": data["logs"]}
+                 "value": metric, "logs": data["logs"],
+                 "span": data.get("span"), **(extra or {})}
             )
             reporter.reset()
 

@@ -316,6 +316,14 @@ class ElasticTPURunnerPool(RunnerPool):
         self._procs: dict = {}  # pid -> (process, chips_set)
         self._spawn_time: dict = {}  # pid -> monotonic start of current proc
         self._free: set = set()
+        # Respawns queued for chips: [(partition_id, chips_needed)]. Kept on
+        # self (under _lock) so the driver's resize watchdog can tell
+        # "queued for chips" (healthy waiting — re-arm the watch) from
+        # "process died before registering" (nothing will ever register —
+        # expire the watch and reclaim the in-flight credit). spawn_stamp()
+        # returns None for BOTH, which is exactly the ambiguity that leaked
+        # credits before.
+        self._pending_respawns: list = []
         self._lock = threading.Lock()
 
     def spawn_stamp(self, partition_id: int):
@@ -338,6 +346,22 @@ class ElasticTPURunnerPool(RunnerPool):
         None when no process exists."""
         t0 = self.spawn_stamp(partition_id)
         return None if t0 is None else time.monotonic() - t0
+
+    def pending_respawn(self, partition_id: int) -> bool:
+        """True while the partition still has a future: its respawn is
+        QUEUED for chips, or a process exists RIGHT NOW (covers the race
+        where the queued respawn was spawned between the watchdog's
+        spawn_stamp() read and this call — without the _procs check the
+        watchdog would misread that healthy just-spawned runner as 'died
+        before registering' and kill it). False is terminal — a pid never
+        re-enters _procs or the pending list once it left both — so the
+        watchdog can safely expire the watch and reclaim the in-flight
+        credit on a False."""
+        with self._lock:
+            if partition_id in self._procs:
+                return True
+            return any(pid == partition_id
+                       for pid, _ in self._pending_respawns)
 
     def _resize_file(self, partition_id: int) -> str:
         return os.path.join(self.resize_dir, "{}.resize".format(partition_id))
@@ -384,7 +408,6 @@ class ElasticTPURunnerPool(RunnerPool):
                 self._spawn(ctx, worker_fn, i, lease)
             self._free = set(chip_ids[self.num_workers * self.chips_per_trial:])
         failures: List[BaseException] = []
-        pending: List[tuple] = []  # (partition_id, chips_needed)
         while True:
             with self._lock:
                 live = dict(self._procs)
@@ -392,9 +415,11 @@ class ElasticTPURunnerPool(RunnerPool):
                       if not p.is_alive()]
             for pid, p, chips in exited:
                 p.join()
-                with self._lock:
-                    self._procs.pop(pid, None)
-                    self._free |= chips
+                # Read the resize request BEFORE releasing the partition's
+                # pool slot: between _procs.pop and the pending append the
+                # driver's watchdog would otherwise see stamp=None AND
+                # pending_respawn=False — the died-before-registering
+                # signature — for a healthy queued respawn.
                 resize = None
                 rf = self._resize_file(pid)
                 if os.path.exists(rf):
@@ -407,22 +432,26 @@ class ElasticTPURunnerPool(RunnerPool):
                         os.unlink(rf)
                     except OSError:
                         pass
+                with self._lock:
+                    self._procs.pop(pid, None)
+                    self._free |= chips
+                    if p.exitcode == 0 and resize:
+                        # resize 0 = retire: chips freed, no respawn
+                        self._pending_respawns.append((pid, resize))
                 if p.exitcode != 0:
                     failures.append(RuntimeError(
                         "Runner process {} died (exit code {})."
                         .format(p.name, p.exitcode)))
-                elif resize:  # resize 0 = retire: chips freed, no respawn
-                    pending.append((pid, resize))
             # Serve respawns whose lease fits the free pool.
-            still_pending = []
-            for pid, k in pending:
-                if k > self.total_chips:
-                    failures.append(RuntimeError(
-                        "Runner {} asked for {} chips but the lease budget "
-                        "is {} (check chips_per_budget).".format(
-                            pid, k, self.total_chips)))
-                    continue
-                with self._lock:
+            with self._lock:
+                still_pending = []
+                for pid, k in self._pending_respawns:
+                    if k > self.total_chips:
+                        failures.append(RuntimeError(
+                            "Runner {} asked for {} chips but the lease "
+                            "budget is {} (check chips_per_budget).".format(
+                                pid, k, self.total_chips)))
+                        continue
                     if self.should_stop():
                         continue  # experiment over: drop the respawn
                     if len(self._free) >= k:
@@ -431,8 +460,8 @@ class ElasticTPURunnerPool(RunnerPool):
                         self._spawn(ctx, worker_fn, pid, lease)
                     else:
                         still_pending.append((pid, k))
-            pending = still_pending
-            with self._lock:
+                self._pending_respawns = still_pending
+                pending = list(still_pending)
                 alive = any(p.is_alive() for p, _ in self._procs.values())
             if not alive and (not pending or self.should_stop()):
                 break

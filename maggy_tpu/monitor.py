@@ -9,6 +9,10 @@ driver process.
 
     python -m maggy_tpu.monitor --ticket /shared/exp_dir/runner_ticket.json
     python -m maggy_tpu.monitor --driver 10.0.0.2:41234 --secret-file s.txt --once
+    python -m maggy_tpu.monitor --ticket .../runner_ticket.json --telem
+
+``--telem`` polls the TELEM verb instead: the driver's live telemetry
+snapshot (trial-span scheduling numbers + RPC service-time histograms).
 """
 
 from __future__ import annotations
@@ -23,16 +27,28 @@ from maggy_tpu import util
 from maggy_tpu.core.rpc import MessageSocket
 
 
-def poll_progress(addr: Tuple[str, int], secret: str,
-                  timeout: float = 10.0) -> Dict[str, Any]:
-    """One LOG round trip: the driver's live progress snapshot."""
+def _poll(addr: Tuple[str, int], secret: str, msg_type: str,
+          timeout: float = 10.0) -> Dict[str, Any]:
     key = secret.encode() if isinstance(secret, str) else secret
     sock = socket.create_connection(addr, timeout=timeout)
     try:
-        MessageSocket.send_msg(sock, {"type": "LOG"}, key)
+        MessageSocket.send_msg(sock, {"type": msg_type}, key)
         return MessageSocket.recv_msg(sock, key)
     finally:
         sock.close()
+
+
+def poll_progress(addr: Tuple[str, int], secret: str,
+                  timeout: float = 10.0) -> Dict[str, Any]:
+    """One LOG round trip: the driver's live progress snapshot."""
+    return _poll(addr, secret, "LOG", timeout=timeout)
+
+
+def poll_telemetry(addr: Tuple[str, int], secret: str,
+                   timeout: float = 10.0) -> Dict[str, Any]:
+    """One TELEM round trip: metrics registry + span-derived scheduling
+    numbers (hand-off gap, early-stop reaction, RPC service times)."""
+    return _poll(addr, secret, "TELEM", timeout=timeout)
 
 
 def render(snap: Dict[str, Any]) -> str:
@@ -51,6 +67,43 @@ def render(snap: Dict[str, Any]) -> str:
     return str({k: v for k, v in snap.items() if k != "type"})
 
 
+def _fmt_dist(stats: Dict[str, Any]) -> str:
+    if not stats:
+        return "n/a"
+    return "median {} ms / p95 {} ms (n={})".format(
+        stats.get("median_ms"), stats.get("p95_ms"), stats.get("n"))
+
+
+def render_telem(snap: Dict[str, Any]) -> str:
+    """Multi-line view of a TELEM snapshot: the scheduling numbers the
+    paper's efficiency claim rests on, plus the busiest RPC verbs."""
+    if snap.get("type") == "ERR":
+        return "telemetry: {}".format(snap.get("error"))
+    if not snap.get("enabled", True):
+        return "telemetry: disabled for this experiment"
+    spans = snap.get("spans") or {}
+    trials = spans.get("trials") or {}
+    lines = [
+        "trials: {} queued / {} finalized / {} early-stopped / {} errors"
+        " / {} lost".format(trials.get("created", 0),
+                            trials.get("finalized", 0),
+                            trials.get("early_stopped", 0),
+                            trials.get("errors", 0), trials.get("lost", 0)),
+        "hand-off gap: {}".format(_fmt_dist(spans.get("handoff") or {})),
+        "early-stop reaction: {}".format(
+            _fmt_dist(spans.get("early_stop_reaction") or {})),
+    ]
+    hists = (snap.get("metrics") or {}).get("histograms") or {}
+    rpc = sorted(((name, h) for name, h in hists.items()
+                  if name.startswith("rpc.handle_ms.")),
+                 key=lambda kv: -kv[1].get("count", 0))
+    for name, h in rpc[:5]:
+        lines.append("rpc {}: n={} p50 {} ms p95 {} ms".format(
+            name[len("rpc.handle_ms."):], h.get("count"),
+            h.get("p50"), h.get("p95")))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="maggy_tpu.monitor", description="Watch a running experiment.")
@@ -65,7 +118,16 @@ def main(argv=None) -> int:
     p.add_argument("--logs", action="store_true",
                    help="also stream executor log lines (reporter.log and, "
                         "with ship_prints=True, user print() output)")
+    p.add_argument("--telem", action="store_true",
+                   help="poll the TELEM verb instead of LOG: span-derived "
+                        "scheduling numbers (hand-off gap, early-stop "
+                        "reaction) and RPC service-time histograms "
+                        "(mutually exclusive with --logs, which streams "
+                        "over the LOG verb)")
     args = p.parse_args(argv)
+    if args.telem and args.logs:
+        p.error("--logs streams over the LOG verb; run it without --telem "
+                "(or use two monitor processes)")
 
     if args.ticket:
         from maggy_tpu.runner import read_ticket
@@ -91,7 +153,8 @@ def main(argv=None) -> int:
     logs_seen = 0
     while True:
         try:
-            snap = poll_progress(addr, secret)
+            snap = (poll_telemetry if args.telem else poll_progress)(
+                addr, secret)
         except (ConnectionError, socket.timeout, OSError) as e:
             if not polled_ok:
                 print("cannot reach driver at {}:{}: {}".format(
@@ -107,7 +170,7 @@ def main(argv=None) -> int:
             continue
         consecutive_failures = 0
         polled_ok = True
-        print(render(snap), flush=True)
+        print(render_telem(snap) if args.telem else render(snap), flush=True)
         if args.logs:
             total = snap.get("log_total", 0)
             tail = snap.get("log_tail", [])

@@ -1,0 +1,177 @@
+"""JSONL telemetry journal, written through the environment abstraction.
+
+``record()`` only appends to an in-memory buffer — NO I/O on the caller's
+thread, so the RPC hot path (METRIC/FINAL handlers on the server event
+loop) never blocks on a disk or GCS write. A daemon flusher thread
+persists the journal every ``flush_interval_s``: the FIRST flush is a full
+atomic rewrite via ``env.dump`` (truncating any stale file from an
+unrelated earlier run at this path), subsequent flushes append only the
+new events through ``env.open_file(path, "a")`` — O(new events), not
+O(journal), per flush. Backends without append semantics (object stores)
+fall back to the full rewrite automatically. A hard kill mid-append can
+leave a torn tail LINE; readers skip it (``_parse_jsonl``), so the journal
+stays old-or-new at event granularity. A crashed experiment therefore
+retains its telemetry up to the last flush; a resumed one loads the prior
+events and keeps appending, so the journal covers the whole logical
+experiment.
+
+Events are plain dicts with at least ``{"t": <unix s>, "ev": <kind>}``;
+trial events add ``{"trial", "span", "phase"}`` (see spans.PHASES).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+FLUSHER_THREAD_NAME = "telemetry-flush"
+
+
+class TelemetryJournal:
+    def __init__(self, env, path: str, flush_interval_s: float = 1.0):
+        self.env = env
+        self.path = path
+        self.flush_interval_s = flush_interval_s
+        self._lock = threading.Lock()
+        # Serializes whole flush cycles (read-suffix -> write -> advance
+        # _flushed): a finalize-path flush() racing the flusher thread's
+        # tick would otherwise both read the same unflushed suffix and
+        # append it twice — duplicated events break replay's
+        # same-journal-same-numbers contract.
+        self._flush_lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        # How many leading events are already on disk. 0 forces the first
+        # flush to be a full rewrite (truncates a stale journal from an
+        # unrelated earlier run at the same path); afterwards flushes
+        # append only events[_flushed:].
+        self._flushed = 0
+        # None = untried, False = backend rejected append mode (object
+        # stores): every flush falls back to the full atomic rewrite.
+        self._append_ok: Optional[bool] = None
+        self._dirty = False
+        self._closed = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._flusher, daemon=True, name=FLUSHER_THREAD_NAME)
+        self._thread.start()
+
+    # ------------------------------------------------------------- hot path
+
+    def record(self, event: Dict[str, Any]) -> None:
+        """Buffer one event. Never touches the filesystem."""
+        with self._lock:
+            if self._closed:
+                return
+            self._events.append(event)
+            self._dirty = True
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    # ----------------------------------------------------------- durability
+
+    def load_existing(self) -> int:
+        """Prepend events persisted by a previous (crashed/interrupted) run
+        of this experiment, so resume keeps one continuous journal. Returns
+        the number of restored events."""
+        try:
+            if not self.env.exists(self.path):
+                return 0
+            restored = _parse_jsonl(self.env.load(self.path))
+        except Exception:  # noqa: BLE001 - a torn journal must not block resume
+            return 0
+        with self._lock:
+            self._events = restored + self._events
+            # _flushed deliberately stays 0: the next flush takes the
+            # full-rewrite path, which re-persists the restored prefix AND
+            # truncates any torn tail line the crashed writer left —
+            # appending after a partial line would glue the first new
+            # event onto it, corrupting both forever.
+            self._dirty = True
+        return len(restored)
+
+    def flush(self) -> None:
+        """Persist now: append the unflushed suffix when the backend
+        supports it, else a full atomic rewrite via env.dump. One flush
+        cycle at a time (see _flush_lock)."""
+        with self._flush_lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        with self._lock:
+            if not self._dirty:
+                return
+            start = self._flushed
+            new = self._events[start:]
+            total = len(self._events)
+            self._dirty = False
+        if start > 0 and self._append_ok is not False:
+            payload = "".join(json.dumps(e, default=str) + "\n" for e in new)
+            try:
+                with self.env.open_file(self.path, "a") as f:
+                    f.write(payload)
+                self._append_ok = True
+                with self._lock:
+                    self._flushed = max(self._flushed, total)
+                return
+            except Exception:  # noqa: BLE001 - backend without append
+                self._append_ok = False
+                # Fall through to the full rewrite, which also repairs any
+                # partial line the failed append may have left.
+        with self._lock:
+            # Copy the refs under the lock, serialize OUTSIDE it: on
+            # backends without append support this path runs every flush,
+            # and O(journal) json.dumps under the buffer lock would stall
+            # record() — i.e. the RPC hot path — for the duration.
+            snapshot = list(self._events[:total])
+        payload = "".join(json.dumps(e, default=str) + "\n" for e in snapshot)
+        try:
+            self.env.dump(payload, self.path)
+            with self._lock:
+                self._flushed = max(self._flushed, total)
+        except Exception:  # noqa: BLE001 - telemetry must never fail a run
+            with self._lock:
+                self._dirty = True
+
+    def _flusher(self) -> None:
+        while not self._stop.wait(self.flush_interval_s):
+            self.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.flush()
+
+
+def _parse_jsonl(text: str) -> List[Dict[str, Any]]:
+    events = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            continue  # torn tail line from a hard kill mid-flush
+        if isinstance(ev, dict):
+            events.append(ev)
+    return events
+
+
+def read_events(path: str, env=None) -> List[Dict[str, Any]]:
+    """Load a journal's events: through ``env`` when given, else the local
+    filesystem (offline replay of a copied artifact)."""
+    if env is not None:
+        return _parse_jsonl(env.load(path))
+    with open(path) as f:
+        return _parse_jsonl(f.read())

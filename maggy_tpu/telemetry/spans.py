@@ -1,0 +1,187 @@
+"""Trial-span tracing: per-trial phase timestamps and derived scheduling
+metrics.
+
+A span is minted when the driver creates a trial and its id travels inside
+the existing RPC payloads (TRIAL info, METRIC, FINAL, the STOP reply), so
+every control-plane hop about a trial can be attributed to one span without
+a new wire protocol. Phases:
+
+    queued -> assigned -> running -> first_metric
+                                  -> stop_flagged -> finalized
+
+``derive()`` is the single source of truth for the numbers the paper's
+scheduling claim rests on — hand-off gap, early-stop reaction latency — and
+is a PURE function over journal events: the same event list always yields
+the same numbers, whether computed live by the driver, over the TELEM RPC,
+or replayed offline from a journal file (bench.py does exactly that).
+"""
+
+from __future__ import annotations
+
+import secrets as pysecrets
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: Trial phases in nominal order (a requeued trial may revisit phases; the
+#: journal records every occurrence, derivation picks the appropriate one).
+PHASES = ("queued", "assigned", "running", "first_metric",
+          "stop_flagged", "stop_sent", "finalized", "lost")
+
+#: Gaps at or above this bound are scheduling (a runner idling on purpose at
+#: a rung barrier), not hand-off overhead — excluded from the gap stats.
+#: Matches the historical bench.py cap so numbers stay comparable.
+HANDOFF_CAP_S = 2.0
+
+
+class TrialSpan:
+    """One trial's phase timeline. ``phases`` keeps the FIRST time each
+    phase was observed; the journal keeps every occurrence."""
+
+    __slots__ = ("span_id", "trial_id", "phases", "partition")
+
+    def __init__(self, span_id: str, trial_id: str):
+        self.span_id = span_id
+        self.trial_id = trial_id
+        self.phases: Dict[str, float] = {}
+        self.partition: Optional[int] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"span": self.span_id, "trial": self.trial_id,
+                "partition": self.partition,
+                "phases": {k: round(v, 6) for k, v in self.phases.items()}}
+
+
+class SpanTracker:
+    """Thread-safe span registry keyed by trial id."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans: Dict[str, TrialSpan] = {}
+
+    def mint(self, trial_id: str) -> str:
+        """Create (or return) the span for ``trial_id``."""
+        with self._lock:
+            span = self._spans.get(trial_id)
+            if span is None:
+                span = TrialSpan(pysecrets.token_hex(6), trial_id)
+                self._spans[trial_id] = span
+            return span.span_id
+
+    def span_id(self, trial_id: str) -> Optional[str]:
+        with self._lock:
+            span = self._spans.get(trial_id)
+            return span.span_id if span else None
+
+    def mark(self, trial_id: str, phase: str, t: Optional[float] = None,
+             partition: Optional[int] = None) -> tuple:
+        """Record ``phase`` on the trial's span (minting it if the caller
+        skipped mint — robustness for resumed/requeued trials). Returns
+        ``(span_id, first)`` where ``first`` says whether this was the
+        phase's first occurrence on the span. Only the first occurrence
+        lands in the span's timeline; the caller decides what to journal
+        (every occurrence by default, first-only for phases a heartbeat
+        loop would otherwise repeat)."""
+        t = time.time() if t is None else t
+        with self._lock:
+            span = self._spans.get(trial_id)
+            if span is None:
+                span = TrialSpan(pysecrets.token_hex(6), trial_id)
+                self._spans[trial_id] = span
+            first = phase not in span.phases
+            span.phases.setdefault(phase, t)
+            if partition is not None:
+                span.partition = int(partition)
+            return span.span_id, first
+
+    def all(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [s.to_dict() for s in self._spans.values()]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+def _dist_stats(values_ms: List[float]) -> Dict[str, Any]:
+    """median/p95/n over a list of millisecond values — the exact shape
+    bench.py's historical ``handoff_gaps`` emitted, so BENCH_*.json stays
+    comparable across rounds."""
+    if not values_ms:
+        return {}
+    ordered = sorted(values_ms)
+    return {"median_ms": round(ordered[len(ordered) // 2], 1),
+            "p95_ms": round(ordered[int(len(ordered) * 0.95)], 1),
+            "n": len(ordered)}
+
+
+def derive(events: List[Dict[str, Any]],
+           handoff_cap_s: float = HANDOFF_CAP_S) -> Dict[str, Any]:
+    """Derived scheduling metrics from journal events (pure function).
+
+    - ``handoff``: per-partition gap from one trial's ``finalized`` to the
+      SAME runner's next trial ``running`` — the control plane's per-trial
+      overhead. Gaps >= ``handoff_cap_s`` (rung-barrier idling) and negative
+      gaps (requeue overlap) are excluded.
+    - ``early_stop_reaction``: ``stop_flagged`` (driver armed the flag) to
+      that trial's ``finalized`` (runner confirmed the stop) — how fast an
+      early-stop frees its runner.
+    - ``trials``: lifecycle counts.
+    """
+    by_partition: Dict[int, List[tuple]] = {}
+    stop_flagged: Dict[str, float] = {}
+    finalized_at: Dict[str, float] = {}
+    finalized = errors = lost = 0
+    # Distinct trials, not 'queued' events: a resumed experiment's
+    # continuous journal re-queues in-flight trials, and double-counting
+    # them would overstate the schedule.
+    created: set = set()
+    early: set = set()
+    for ev in events:
+        if ev.get("ev") != "trial":
+            continue
+        phase, t, trial = ev.get("phase"), ev.get("t"), ev.get("trial")
+        if t is None or trial is None:
+            continue
+        if phase == "queued":
+            created.add(trial)
+        elif phase == "running":
+            pid = ev.get("partition")
+            if pid is not None:
+                by_partition.setdefault(int(pid), []).append(("run", t, trial))
+        elif phase == "stop_flagged":
+            stop_flagged.setdefault(trial, t)
+        elif phase == "lost":
+            lost += 1
+        elif phase == "finalized":
+            finalized += 1
+            if ev.get("error"):
+                errors += 1
+            if ev.get("early_stop"):
+                early.add(trial)
+            finalized_at[trial] = t
+            pid = ev.get("partition")
+            if pid is not None:
+                by_partition.setdefault(int(pid), []).append(("fin", t, trial))
+    gaps: List[float] = []
+    for seq in by_partition.values():
+        seq.sort(key=lambda e: e[1])
+        last_fin = None
+        for kind, t, _trial in seq:
+            if kind == "fin":
+                last_fin = t
+            elif last_fin is not None:  # "run" after a finalize
+                gap = t - last_fin
+                if 0 <= gap < handoff_cap_s:
+                    gaps.append(gap * 1e3)
+                last_fin = None
+    reactions = [(finalized_at[tid] - t0) * 1e3
+                 for tid, t0 in stop_flagged.items()
+                 if tid in finalized_at and finalized_at[tid] >= t0]
+    return {
+        "trials": {"created": len(created), "finalized": finalized,
+                   "early_stopped": len(early), "errors": errors,
+                   "lost": lost},
+        "handoff": _dist_stats(gaps),
+        "early_stop_reaction": _dist_stats(reactions),
+    }

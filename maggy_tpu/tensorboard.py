@@ -151,15 +151,21 @@ class _EventWriter:
     def write_experiment(self, summary_pb) -> None:
         self._writer.add_event(self._event(summary=summary_pb))
 
+    def finish(self) -> None:
+        """Flush + close the event file and upload a staged remote logdir
+        — the teardown every one-shot writer (experiment config, telemetry
+        scalars) needs, without the per-trial session_end record."""
+        self._writer.flush()
+        self._writer.close()
+        if self._remote_dir is not None:
+            _upload_tree(self._staging_dir, self._remote_dir)
+
     def close(self) -> None:
         try:
             self._writer.add_event(self._event(summary=_session_end_summary()))
         except Exception:  # noqa: BLE001 - close must always flush
             pass
-        self._writer.flush()
-        self._writer.close()
-        if self._remote_dir is not None:
-            _upload_tree(self._staging_dir, self._remote_dir)
+        self.finish()
 
 
 def _is_remote(path: str) -> bool:
@@ -304,10 +310,42 @@ def write_experiment_config(exp_dir: str, searchspace) -> None:
                          if _is_remote(exp_dir)
                          else os.path.join(exp_dir, "tensorboard"))
         w.write_experiment(pb)
-        w._writer.flush()
-        w._writer.close()
-        if w._remote_dir is not None:
-            _upload_tree(w._staging_dir, w._remote_dir)
+        w.finish()
+    except Exception:  # noqa: BLE001 - TB must never block an experiment
+        pass
+
+
+def write_telemetry_scalars(exp_dir: str, snapshot: Dict[str, Any]) -> None:
+    """Mirror a telemetry snapshot's derived scheduling numbers into the
+    experiment-level TensorBoard dir (next to the hparams config), so the
+    dashboard shows hand-off gap / early-stop reaction alongside the sweep.
+    Best-effort like every TB artifact; JSON fallback when the tensorboard
+    package is absent."""
+    spans = (snapshot or {}).get("spans") or {}
+    scalars: Dict[str, float] = {}
+    for group in ("handoff", "early_stop_reaction"):
+        stats = spans.get(group) or {}
+        for key in ("median_ms", "p95_ms", "n"):
+            if stats.get(key) is not None:
+                scalars["telemetry/{}_{}".format(group, key)] = float(stats[key])
+    for key, val in (spans.get("trials") or {}).items():
+        scalars["telemetry/trials_{}".format(key)] = float(val)
+    if not scalars:
+        return
+    logdir = ("/".join((exp_dir, "tensorboard")) if _is_remote(exp_dir)
+              else os.path.join(exp_dir, "tensorboard"))
+    try:
+        w = _EventWriter(logdir)
+    except Exception:  # noqa: BLE001 - tensorboard optional; JSON fallback
+        if not _is_remote(logdir):
+            os.makedirs(logdir, exist_ok=True)
+            with open(os.path.join(logdir, "telemetry_scalars.json"), "w") as f:
+                json.dump(scalars, f, indent=2)
+        return
+    try:
+        for tag, value in sorted(scalars.items()):
+            w.add_scalar(tag, value, 0)
+        w.finish()
     except Exception:  # noqa: BLE001 - TB must never block an experiment
         pass
 

@@ -233,7 +233,8 @@ def feature_dropping_generator(source):
     return generator
 
 
-def load_path_dataset(path, columns=None, file_shard=None):
+def load_path_dataset(path, columns=None, file_shard=None,
+                      registry_root=None):
     """Load an on-disk dataset into a dict of numpy arrays.
 
     Supported formats: a ``.npz`` archive, a single ``.parquet`` file, a
@@ -241,8 +242,10 @@ def load_path_dataset(path, columns=None, file_shard=None):
     or a directory of them (the reference's feature-store format,
     `loco.py:41-80`), plus ``registry://name[@version]`` URIs resolved
     through the dataset registry (train/registry.py — the featurestore-
-    equivalent indirection). ``file_shard=(current, count)`` restricts a
-    parquet/tfrecord directory to files ``[current::count]`` (file-level
+    equivalent indirection); ``registry_root`` (or
+    $MAGGY_TPU_REGISTRY_ROOT) addresses a registry outside the default
+    ``<base dir>/datasets`` root. ``file_shard=(current, count)`` restricts
+    a parquet/tfrecord directory to files ``[current::count]`` (file-level
     sharding; single files and npz archives reject it — there is nothing to
     split without reading everything anyway).
     """
@@ -252,7 +255,7 @@ def load_path_dataset(path, columns=None, file_shard=None):
     from maggy_tpu.train import tfrecord as _tfr
 
     if _reg.is_registry_uri(path):
-        path = _reg.resolve_path(path)
+        path = _reg.resolve_path(path, root=registry_root)
 
     if _tfr.is_tfrecord_path(path):
         if os.path.isdir(path):

@@ -19,9 +19,17 @@ from __future__ import annotations
 
 import datetime
 import json
+import os
 from typing import Any, Dict, List, Optional
 
 REGISTRY_SCHEME = "registry://"
+
+#: Overrides the default registry root (``<env base dir>/datasets``) for
+#: every consumer that does not pass an explicit root — the data loaders'
+#: ``registry://`` resolution and LOCO's registry probe included. Makes
+#: custom-root registries URI-addressable without threading a root through
+#: each call site.
+REGISTRY_ROOT_ENV_VAR = "MAGGY_TPU_REGISTRY_ROOT"
 
 
 def _env():
@@ -39,7 +47,8 @@ class DatasetRegistry:
 
     def __init__(self, env=None, root: Optional[str] = None):
         self.env = env or _env()
-        self.root = root or self.env.experiment_base_dir() + "/datasets"
+        self.root = (root or os.environ.get(REGISTRY_ROOT_ENV_VAR)
+                     or self.env.experiment_base_dir() + "/datasets")
 
     # ------------------------------------------------------------- manifest
     def _dir(self, name: str) -> str:
@@ -89,13 +98,13 @@ class DatasetRegistry:
         }
         self.env.mkdir(self._dir(name))
         payload = json.dumps(manifest, indent=2)
-        self.env.dump(payload, mpath)
         # Concurrent registrations of the same name can race the
-        # exists()-then-dump window and pick the same auto-version; the
-        # env's atomic dump makes exactly one writer win, so read back and
-        # make the LOSER fail loudly instead of silently believing its
-        # manifest was recorded.
-        if self.env.load(mpath) != payload:
+        # exists()-then-dump window and pick the same auto-version;
+        # exclusive_create (O_CREAT|O_EXCL locally, if_generation_match=0
+        # on GCS) makes exactly ONE writer win and every loser fail loudly
+        # — dump()'s atomicity alone only prevented torn files, not
+        # last-writer-wins lost updates.
+        if not self.env.exclusive_create(payload, mpath):
             raise ValueError(
                 "{}@{} was registered concurrently by another writer; "
                 "retry to get a fresh version number.".format(name, version))
@@ -172,10 +181,11 @@ def is_registry_uri(path: Any) -> bool:
     return isinstance(path, str) and path.startswith(REGISTRY_SCHEME)
 
 
-def resolve_path(uri: str, env=None) -> str:
+def resolve_path(uri: str, env=None, root: Optional[str] = None) -> str:
     """Registry URI -> concrete dataset path (module-level convenience for
-    the data loaders)."""
-    return DatasetRegistry(env=env).resolve(uri)["path"]
+    the data loaders). ``root`` (or $MAGGY_TPU_REGISTRY_ROOT) addresses a
+    registry living outside the default ``<base dir>/datasets`` root."""
+    return DatasetRegistry(env=env, root=root).resolve(uri)["path"]
 
 
 def _format_of(path: str) -> str:
