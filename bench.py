@@ -559,6 +559,41 @@ def headline_main():
     return 0
 
 
+def chaos_main():
+    """``bench.py --chaos``: deterministic fault-injection soak (see
+    maggy_tpu/chaos/). Runs the standard plan (runner kill mid-trial,
+    false preemption, METRIC drops, severed FINAL replies) against a real
+    local sweep and prints one JSON line with the invariant verdict and
+    the fault->requeue recovery latencies replayed from the telemetry
+    journal. Exit 1 if any recovery invariant is violated."""
+    if "MAGGY_TPU_BASE_DIR" not in os.environ:
+        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
+    _force_cpu_if_requested()
+    from maggy_tpu.chaos.harness import run_soak
+
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "7"))
+    t0 = time.time()
+    report = run_soak(seed=seed,
+                      num_trials=int(os.environ.get("BENCH_CHAOS_TRIALS",
+                                                    "12")))
+    print(json.dumps({
+        "metric": "chaos soak (kill+preempt+drop+sever, journal-checked)",
+        "value": 1.0 if report["ok"] else 0.0,
+        "unit": "invariants_ok",
+        "detail": {
+            "seed": seed,
+            "wall_s": round(time.time() - t0, 1),
+            "violations": report["violations"],
+            "faults": report["faults"],
+            "recoveries": report["recoveries"],
+            "trials": report["trials"],
+            "client_retries": report["client_retries"],
+            "journal": report["journal"],
+        },
+    }), flush=True)
+    return 0 if report["ok"] else 1
+
+
 def extra_main(name):
     """Child process: run ONE extra bench and print its JSON on stdout."""
     if name == "hang":  # test hook: simulates a compile stall / wedged op
@@ -995,4 +1030,6 @@ if __name__ == "__main__":
         sys.exit(headline_main())
     if "--extra" in sys.argv:
         sys.exit(extra_main(sys.argv[sys.argv.index("--extra") + 1]))
+    if "--chaos" in sys.argv:
+        sys.exit(chaos_main())
     sys.exit(main())

@@ -1,0 +1,46 @@
+"""Chaos engine: deterministic fault injection + journal-checked recovery.
+
+The fault-tolerance claims (runner death survived, trials requeued, RPC
+retried, preemption tolerated) become first-class, seeded inputs instead
+of incidental races:
+
+- ``plan``      — declarative JSON ``FaultPlan`` (kill/stall/preempt a
+                  runner, drop/delay/sever messages, fail env writes),
+                  expanded deterministically from one seed;
+- ``injectors`` — the ``ChaosEngine`` behind no-op-by-default hook points
+                  in the RPC server/client, runner pools, heartbeat
+                  bookkeeping, and the environment's write paths; armed
+                  via ``config.chaos`` or ``MAGGY_TPU_CHAOS=<plan.json>``;
+- ``harness``   — soak runner that executes a lagom experiment under a
+                  plan, journals every injection, then replays the
+                  telemetry journal and asserts the recovery invariants
+                  (no lost trial, no duplicate FINAL, bounded requeue,
+                  experiment completes);
+- CLI           — ``python -m maggy_tpu.chaos --seed 7 [--plan p.json]``.
+
+See docs/chaos.md.
+"""
+
+from maggy_tpu.chaos.injectors import (ChaosEngine, ChaosKilled,
+                                       active_engine, arm, disarm)
+from maggy_tpu.chaos.plan import KINDS, RUNNER_KINDS, FaultPlan, FaultSpec
+
+__all__ = [
+    "FaultPlan", "FaultSpec", "KINDS", "RUNNER_KINDS",
+    "ChaosEngine", "ChaosKilled", "active_engine", "arm", "disarm",
+    # lazy (import cycle: harness pulls in the experiment stack, which
+    # pulls in the RPC layer, which imports chaos.injectors):
+    "default_plan", "run_soak", "check_invariants", "assert_invariants",
+]
+
+_HARNESS_NAMES = ("default_plan", "run_soak", "check_invariants",
+                  "assert_invariants")
+
+
+def __getattr__(name):
+    if name in _HARNESS_NAMES:
+        from maggy_tpu.chaos import harness
+
+        return getattr(harness, name)
+    raise AttributeError("module {!r} has no attribute {!r}".format(
+        __name__, name))

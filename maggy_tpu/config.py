@@ -33,6 +33,29 @@ class LagomConfig:
     #: buffer-only (journal writes happen on a background flusher), so the
     #: default-on cost on the message hot path is a few dict ops.
     telemetry: bool = True
+    #: Heartbeat-loss detection shape (used when ``hb_loss_timeout`` is
+    #: None): a runner is declared lost after
+    #: max(hb_loss_min_s, hb_interval * hb_loss_factor) seconds of
+    #: silence. Overridable per experiment so soak/chaos tests can tighten
+    #: failure detection without monkeypatching module globals.
+    hb_loss_factor: float = constants.HEARTBEAT_LOSS_FACTOR
+    hb_loss_min_s: float = constants.HEARTBEAT_LOSS_MIN_S
+    #: Fault injection (maggy_tpu.chaos): a FaultPlan instance or a path
+    #: to a plan JSON. None (default) = every chaos hook is a no-op. Also
+    #: armable without touching code via MAGGY_TPU_CHAOS=<plan.json>.
+    chaos: Any = None
+
+    def resolved_hb_loss_timeout(self) -> float:
+        """Seconds of heartbeat silence before a runner/worker is
+        declared lost: the explicit ``hb_loss_timeout`` field when set
+        (OptimizationConfig/DistributedConfig), else the configured shape
+        max(hb_loss_min_s, hb_interval * hb_loss_factor). The ONE home of
+        this resolution — both driver families consult it."""
+        explicit = getattr(self, "hb_loss_timeout", None)
+        if explicit is not None:
+            return float(explicit)
+        return max(self.hb_loss_min_s,
+                   self.hb_interval * self.hb_loss_factor)
 
 
 @dataclass

@@ -30,12 +30,19 @@ REGISTRATION_TIMEOUT_S = 600.0
 RESIZE_RESPAWN_TIMEOUT_S = 120.0
 RENDEZVOUS_TIMEOUT_S = 60.0
 CLIENT_MAX_RETRIES = 3
+# Client retry backoff: exponential from BASE doubling to CAP, with full
+# jitter (a fixed cadence synchronizes every client's retry storm onto a
+# recovering server).
+CLIENT_RETRY_BACKOFF_BASE_S = 0.05
+CLIENT_RETRY_BACKOFF_CAP_S = 2.0
 RPC_RECV_BUFSIZE = 1 << 16
 
 # Failure detection: a runner whose assigned trial has gone this many
 # heartbeat intervals without any message is declared lost and its trial is
 # requeued to another runner (floor guards against sub-second hb_interval
-# settings declaring a compiling trial dead).
+# settings declaring a compiling trial dead). Defaults for the
+# ``hb_loss_factor`` / ``hb_loss_min_s`` config fields — override THOSE
+# (e.g. chaos soaks tightening failure detection), not these globals.
 HEARTBEAT_LOSS_FACTOR = 30.0
 HEARTBEAT_LOSS_MIN_S = 10.0
 

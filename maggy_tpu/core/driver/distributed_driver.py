@@ -32,12 +32,7 @@ class DistributedDriver(Driver):
         # A silent SPMD worker deadlocks the whole world's collectives —
         # heartbeat loss surfaces it as a failed experiment rather than a
         # hang (see DistributedServer._tick).
-        from maggy_tpu import constants
-
-        self.server.hb_loss_timeout = getattr(config, "hb_loss_timeout", None) or max(
-            constants.HEARTBEAT_LOSS_MIN_S,
-            self.hb_interval * constants.HEARTBEAT_LOSS_FACTOR,
-        )
+        self.server.hb_loss_timeout = config.resolved_hb_loss_timeout()
 
     def _make_server(self):
         return DistributedServer(self.num_workers, secret=self.secret)

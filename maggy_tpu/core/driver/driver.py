@@ -15,6 +15,7 @@ processes pinned to chip sub-slices).
 
 from __future__ import annotations
 
+import os
 import queue
 import secrets as pysecrets
 import threading
@@ -80,6 +81,34 @@ class Driver(ABC):
         self.telemetry.event("experiment", phase="start", name=self.name,
                              driver=type(self).__name__, app_id=app_id,
                              run_id=run_id)
+        # Fault injection (maggy_tpu.chaos): armed ONLY when a plan is
+        # named — via config.chaos (FaultPlan or plan-JSON path) or
+        # MAGGY_TPU_CHAOS=<plan.json>. Unarmed, every chaos hook in the
+        # RPC/pool/env seams is a no-op global read.
+        self.chaos = None
+        plan_src = getattr(config, "chaos", None) \
+            or os.environ.get("MAGGY_TPU_CHAOS")
+        if plan_src:
+            from maggy_tpu.chaos import ChaosEngine, FaultPlan, arm
+
+            if not self.telemetry.enabled:
+                # Without telemetry there are no phase events to trigger
+                # on and no journal to record injections in — the plan
+                # would be a silent no-op and the soak would "pass".
+                raise ValueError(
+                    "chaos fault injection requires telemetry=True: "
+                    "on_phase triggers ride trial-span events and every "
+                    "injection must be journaled for the recovery "
+                    "invariants to be checkable")
+            plan = plan_src if isinstance(plan_src, FaultPlan) \
+                else FaultPlan.load(plan_src, env=self.env)
+            self.chaos = ChaosEngine(plan, telemetry=self.telemetry)
+            self.chaos.attach(reservations=self.server.reservations)
+            # Phase transitions feed on-state-transition triggers.
+            self.telemetry.chaos_hook = self.chaos.on_trial_phase
+            arm(self.chaos)
+            self.telemetry.event("chaos_armed", seed=plan.seed,
+                                 specs=len(plan.specs))
         self._register_msg_callbacks()
 
     # ------------------------------------------------------------- template
@@ -120,6 +149,9 @@ class Driver(ABC):
             self.init()
             pool = self._make_runner_pool()
             self._active_pool = pool
+            if self.chaos is not None:
+                # Late-bind the pool: kill/stall faults act through it.
+                self.chaos.attach(pool=pool)
             # Fan out the executor wrapper to all runners; BLOCKS until all
             # workers return (the reference's foreachPartition semantics).
             failures = pool.run(self._executor_fn(train_fn)) or []
@@ -198,6 +230,13 @@ class Driver(ABC):
         if self._worker_thread is not None:
             self._worker_thread.join(timeout=5)
         self.server.stop()
+        if self.chaos is not None:
+            # Journal the injection tally, then disarm (only if WE are the
+            # armed engine — a newer experiment's must survive).
+            from maggy_tpu.chaos import disarm
+
+            self.telemetry.event("chaos_summary", **self.chaos.summary())
+            disarm(self.chaos)
         self.telemetry.event("experiment", phase="end")
         self.telemetry.close()
 

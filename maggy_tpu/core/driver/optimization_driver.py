@@ -98,11 +98,11 @@ class OptimizationDriver(Driver):
         # watch on resize respawns (see periodic_check).
         self._resize_watch: Dict[int, tuple] = {}
         # Arm heartbeat-loss detection (SURVEY.md §5.3): a silent runner's
-        # trial is requeued to whichever runner asks for work next.
-        self.server.hb_loss_timeout = getattr(config, "hb_loss_timeout", None) or max(
-            constants.HEARTBEAT_LOSS_MIN_S,
-            self.hb_interval * constants.HEARTBEAT_LOSS_FACTOR,
-        )
+        # trial is requeued to whichever runner asks for work next. The
+        # loss shape (floor + interval multiple) is per-experiment config
+        # so soak/chaos tests can tighten detection without monkeypatching
+        # the module-global defaults.
+        self.server.hb_loss_timeout = config.resolved_hb_loss_timeout()
         self.earlystop_check = self._init_earlystop(config)
         self.es_interval = config.es_interval
         self.es_min = config.es_min
@@ -348,6 +348,12 @@ class OptimizationDriver(Driver):
         trial = self.get_trial(msg["trial_id"])
         if trial is not None:
             trial.reset_run_state()
+            # Explicit requeue edge BEFORE the reassignment: recovery
+            # latency (fault -> requeued -> assigned) must be derivable
+            # from the journal (the chaos harness asserts on it).
+            self.telemetry.trial_event(trial.trial_id, "requeued",
+                                       partition=msg["partition_id"],
+                                       reason="blacklist")
             self.server.reservations.assign_trial(msg["partition_id"], trial.trial_id)
             self.telemetry.trial_event(trial.trial_id, "assigned",
                                        partition=msg["partition_id"],
@@ -369,6 +375,12 @@ class OptimizationDriver(Driver):
                 self._requeue.append(trial.trial_id)
         self.telemetry.trial_event(trial.trial_id, "lost",
                                    partition=msg.get("partition_id"))
+        # The explicit re-queue edge: without it the journal only shows a
+        # later "assigned" whose span timestamp is NOT overwritten (spans
+        # keep first occurrences), leaving recovery latency underivable.
+        self.telemetry.trial_event(trial.trial_id, "requeued",
+                                   partition=msg.get("partition_id"),
+                                   reason="heartbeat_loss")
         self.result["lost_runners"] = self.result.get("lost_runners", 0) + 1
         self._log("runner {} heartbeat lost; trial {} requeued for reassignment".format(
             msg["partition_id"], msg["trial_id"]))
@@ -376,7 +388,15 @@ class OptimizationDriver(Driver):
         # runner wedged inside a native call (compile stall, stuck device
         # op) never returns on its own. Process pools kill just that one
         # worker; the experiment completes on the survivors and the killed
-        # runner surfaces as a survivable pool failure.
+        # runner surfaces as a survivable pool failure. Exception: a
+        # chaos-faked preemption — the runner is HEALTHY by construction
+        # and must stay alive to deliver the duplicate FINAL the fault
+        # exists to provoke.
+        if self.chaos is not None and \
+                self.chaos.suppress_reap(msg.get("partition_id")):
+            self._log("runner {} loss was a chaos-faked preemption; "
+                      "reap suppressed".format(msg["partition_id"]))
+            return
         pool = getattr(self, "_active_pool", None)
         if pool is not None and pool.kill_worker(msg["partition_id"]):
             self._log("runner {} killed after heartbeat loss (presumed "
@@ -552,10 +572,16 @@ class OptimizationDriver(Driver):
         trial = self.get_trial(msg.get("trial_id"))
         if trial is None:
             # Duplicate FINAL (e.g. a falsely-declared-lost runner finishing a
-            # trial another runner re-ran). The result is already recorded,
+            # trial another runner re-ran, or a retried FINAL whose first
+            # delivery's reply was lost). The result is already recorded,
             # but the reporting runner still needs its next assignment or it
-            # would poll GET empty-handed forever.
-            self._assign_next(msg["partition_id"], None)
+            # would poll GET empty-handed forever — UNLESS it already holds
+            # an undelivered one (the retry raced the hand-off): assigning
+            # again would orphan that trial in the store and hang the
+            # experiment's in-flight wait.
+            if self.server.reservations.get_assigned_trial(
+                    msg["partition_id"]) is None:
+                self._assign_next(msg["partition_id"], None)
             return
         with trial.lock:
             if msg.get("error"):
@@ -663,6 +689,9 @@ class OptimizationDriver(Driver):
                 with self._store_lock:
                     self._trial_store[suggestion.trial_id] = suggestion
                     self._requeue.append(suggestion.trial_id)
+                self.telemetry.trial_event(suggestion.trial_id, "requeued",
+                                           partition=partition_id,
+                                           reason="dead_partition")
             # 'released' partitions saw GSTOP and never come back — drop
             # their IDLE chain. A 'silent' one may be a transient stall
             # (network hiccup): keep ticking so it resumes getting work if

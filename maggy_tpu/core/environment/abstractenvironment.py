@@ -21,6 +21,17 @@ from typing import Any, Dict, List, Optional
 class AbstractEnv(ABC):
     """Filesystem + experiment-registry services used by driver & executors."""
 
+    @staticmethod
+    def _chaos_write_check(path: str) -> None:
+        """Fault-injection seam (maggy_tpu.chaos ``env_write_fail``):
+        raises OSError when an armed chaos engine decides this write
+        fails transiently. Unarmed (the default), one global read."""
+        from maggy_tpu.chaos.injectors import active_engine
+
+        engine = active_engine()
+        if engine is not None:
+            engine.on_env_write(path)
+
     # ------------------------------------------------------------------- fs
 
     def exists(self, path: str) -> bool:
@@ -127,6 +138,7 @@ class LocalEnv(AbstractEnv):
         # Atomic (tmp + rename): artifacts like trial.json and the pruner
         # bracket state are read back by `resume=True` — a hard kill
         # mid-write must leave old-or-nothing, never a torn file.
+        self._chaos_write_check(path)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         import threading
 
@@ -155,6 +167,7 @@ class LocalEnv(AbstractEnv):
         # O_CREAT|O_EXCL write could).
         import threading
 
+        self._chaos_write_check(path)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = "{}.tmp.{}.{}".format(path, os.getpid(), threading.get_ident())
         try:
@@ -287,6 +300,7 @@ class GCSEnv(LocalEnv):
         # One-shot object write: object stores commit the whole object on
         # close (old-or-nothing), so no tmp+rename dance — and no rename
         # exists on GCS anyway. sweep_tmp_files() stays the base no-op.
+        self._chaos_write_check(path)
         with self.fs.open(path, "w") as f:
             f.write(data)
 
@@ -297,6 +311,7 @@ class GCSEnv(LocalEnv):
         # support (fsspec's memory fs in tests) silently ignore the kwarg,
         # which is why the exists() pre-check stays: best-effort there,
         # bulletproof on real gcsfs.
+        self._chaos_write_check(path)
         if self.fs.exists(path):
             return False
         try:

@@ -36,11 +36,20 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import msgpack
 
 from maggy_tpu import constants
+from maggy_tpu.chaos.injectors import ChaosKilled
+from maggy_tpu.chaos.injectors import active_engine as chaos_engine
 from maggy_tpu.exceptions import AuthenticationError
+from maggy_tpu.telemetry.metrics import MetricsRegistry
 from maggy_tpu.trial import Trial
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
+
+#: Process-wide client-side RPC metrics (retries/reconnects). Module-level
+#: because clients outlive no experiment and may run in runner processes
+#: with no driver telemetry; in-process pools share it with the driver, so
+#: chaos soaks can assert the retry paths actually ran.
+CLIENT_METRICS = MetricsRegistry()
 
 # Sentinel trial id returned by Client.get_suggestion when the driver asks
 # this runner to exit and respawn pinned to a different chip count.
@@ -109,11 +118,29 @@ class Reservations:
             self._table[int(meta["partition_id"])] = rec
 
     def touch(self, partition_id) -> None:
-        """Record liveness: any message from the runner counts as a beat."""
+        """Record liveness: any message from the runner counts as a beat.
+        A chaos mute window (see ``age_beat``) suppresses the update."""
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            if rec is not None and \
+                    rec.get("mute_until", 0.0) <= time.monotonic():
+                rec["last_beat"] = time.monotonic()
+
+    def age_beat(self, partition_id, age_s: float,
+                 mute_s: float = 0.0) -> None:
+        """Fault-injection support (maggy_tpu.chaos ``fake_preemption``):
+        push the partition's last_beat ``age_s`` into the past and ignore
+        fresh beats for ``mute_s`` seconds, so the heartbeat-loss scan
+        sees a silent runner while the runner itself stays alive — the
+        falsely-declared-lost race, injected on demand."""
         with self.lock:
             rec = self._table.get(int(partition_id))
             if rec is not None:
-                rec["last_beat"] = time.monotonic()
+                now = time.monotonic()
+                rec["last_beat"] = min(rec.get("last_beat", now),
+                                       now - age_s)
+                if mute_s > 0:
+                    rec["mute_until"] = now + mute_s
 
     def _silent_locked(self, timeout: float):
         now = time.monotonic()
@@ -199,6 +226,20 @@ class Reservations:
         with self.lock:
             if int(partition_id) in self._table:
                 self._table[int(partition_id)]["trial_id"] = trial_id
+
+    def clear_trial_if(self, partition_id: int,
+                       trial_id: Optional[str]) -> None:
+        """Clear the partition's assignment ONLY if it still names
+        ``trial_id``. The FINAL handler must use this, not a blind
+        assign_trial(None): under at-least-once delivery (reply lost,
+        client retries) the retried FINAL arrives AFTER the driver has
+        already assigned the partition its NEXT trial, and a blind wipe
+        strands that trial in the store forever — the experiment never
+        completes. Found by the chaos harness's sever_conn fault."""
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            if rec is not None and rec.get("trial_id") == trial_id:
+                rec["trial_id"] = None
 
     def mark_released(self, partition_id) -> None:
         """The runner has been told GSTOP — it will send nothing more."""
@@ -404,8 +445,28 @@ class Server:
         return payload
 
     def _dispatch(self, conn, payload: bytes):
+        sever_reply = False
         try:
             msg = msgpack.unpackb(payload, raw=False, strict_map_key=False)
+            engine = chaos_engine()
+            if engine is not None:
+                action = engine.on_server_message(msg)
+                if action is not None:
+                    if action[0] == "drop":
+                        # Message lost + connection reset: the client's
+                        # retry/reconnect path re-delivers.
+                        self._drop(conn)
+                        return
+                    if action[0] == "delay":
+                        # Deliberately ON the event loop: a stalled
+                        # control plane stalls every client, which is the
+                        # fault being simulated.
+                        time.sleep(action[1])
+                    elif action[0] == "sever":
+                        # Handle, then cut the connection INSTEAD of
+                        # replying — the client retries and the handler
+                        # runs twice (at-least-once delivery).
+                        sever_reply = True
             handler = self._handlers.get(msg.get("type"))
             if handler is None:
                 resp = {"type": "ERR", "error": "unknown message type"}
@@ -425,6 +486,9 @@ class Server:
             return
         except Exception as e:  # noqa: BLE001 - a bad message must never kill the loop
             resp = {"type": "ERR", "error": "handler error: {!r}".format(e)}
+        if sever_reply:
+            self._drop(conn)
+            return
         try:
             conn.setblocking(True)
             MessageSocket.send_msg(conn, resp, self.secret)
@@ -453,6 +517,11 @@ class Server:
             for key, mask in events:
                 key.data(key.fileobj, mask)
             self._tick()
+            engine = chaos_engine()
+            if engine is not None:
+                # Elapsed-time fault triggers ride the event-loop tick —
+                # the same cadence the heartbeat-loss scan runs on.
+                engine.tick()
 
     def _tick(self) -> None:
         """Periodic hook run on the event-loop thread between selects."""
@@ -588,7 +657,10 @@ class OptimizationServer(Server):
 
     def _final(self, msg):
         self.reservations.touch(msg["partition_id"])
-        self.reservations.assign_trial(msg["partition_id"], None)
+        # Conditional, not assign_trial(None): a RETRIED final (severed /
+        # lost reply) must not wipe the next trial assigned in between.
+        self.reservations.clear_trial_if(msg["partition_id"],
+                                         msg.get("trial_id"))
         self.driver.enqueue(dict(msg))
         return {"type": "OK"}
 
@@ -767,12 +839,27 @@ class Client:
 
     def _request(self, msg: Dict[str, Any], sock: Optional[socket.socket] = None,
                  lock: bool = True) -> Dict[str, Any]:
-        """Send one message with reconnect retries (reference `rpc.py:465-493`)."""
+        """Send one message with reconnect retries (reference `rpc.py:465-493`).
+
+        Retries back off exponentially with full jitter, capped: the fixed
+        cadence this replaces synchronized every client's retry storm onto
+        a recovering server (64 runners reconnecting in lockstep after a
+        driver stall is its own outage). Retries and reconnects are
+        counted in ``CLIENT_METRICS`` so chaos soaks can assert the
+        degraded paths actually ran."""
+        import random as _random
+
         target = sock or self._sock
         msg = {**msg, "partition_id": self.partition_id,
                "task_attempt": self.task_attempt}
         last_err = None
+        delay = constants.CLIENT_RETRY_BACKOFF_BASE_S
         for attempt in range(constants.CLIENT_MAX_RETRIES + 1):
+            engine = chaos_engine()
+            if engine is not None:
+                # May sleep (cooperative stall) or raise ChaosKilled (a
+                # condemned runner dies here, outside the retry net).
+                engine.on_client_request(msg)
             try:
                 if lock and target is self._sock:
                     with self._lock:
@@ -780,10 +867,24 @@ class Client:
                         return MessageSocket.recv_msg(target, self.secret)
                 MessageSocket.send_msg(target, msg, self.secret)
                 return MessageSocket.recv_msg(target, self.secret)
+            except ChaosKilled:
+                raise
             except (ConnectionError, socket.timeout, OSError) as e:
                 last_err = e
-                time.sleep(0.2 * (attempt + 1))
-                fresh = self._connect()
+                if attempt >= constants.CLIENT_MAX_RETRIES:
+                    break
+                CLIENT_METRICS.counter("rpc.client.retries").inc()
+                # Full jitter in [delay/2, delay]: staggered, still bounded.
+                time.sleep(delay * (0.5 + 0.5 * _random.random()))
+                delay = min(delay * 2, constants.CLIENT_RETRY_BACKOFF_CAP_S)
+                try:
+                    fresh = self._connect()
+                except OSError as conn_err:
+                    # Server not back yet: keep the stale socket as the
+                    # nominal target and burn another attempt.
+                    last_err = conn_err
+                    continue
+                CLIENT_METRICS.counter("rpc.client.reconnects").inc()
                 if target is self._sock:
                     self._sock = fresh
                 elif target is self._hb_sock:

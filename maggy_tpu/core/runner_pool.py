@@ -160,6 +160,14 @@ class RunnerPool(ABC):
         pool ('process'/'tpu')."""
         return False
 
+    def stall_worker(self, partition_id: int, duration_s: float) -> bool:
+        """Freeze ONE worker for ``duration_s`` seconds (fault injection:
+        maggy_tpu.chaos ``stall_runner`` — the straggler/compile-stall
+        simulator). Process pools SIGSTOP the process and SIGCONT it from
+        a timer; thread pools return False and the chaos engine falls
+        back to a cooperative RPC-hook stall."""
+        return False
+
 
 class ThreadRunnerPool(RunnerPool):
     def run(self, worker_fn: Callable[[int], None]) -> List[BaseException]:
@@ -213,6 +221,34 @@ def _process_entry(worker_fn, pid, chip_env):
     worker_fn(pid)
 
 
+def _stall_process(p, duration_s: float) -> bool:
+    """SIGSTOP ``p`` now, SIGCONT it from a daemon timer after
+    ``duration_s`` (fault injection: a straggler whose heartbeats freeze
+    mid-trial). Best effort: a process that exits during the stall is
+    simply not resumed."""
+    import signal
+    import threading as _threading
+
+    if not (p.is_alive() and p.pid):
+        return False
+    try:
+        os.kill(p.pid, signal.SIGSTOP)
+    except OSError:
+        return False
+
+    def _resume():
+        try:
+            if p.is_alive():
+                os.kill(p.pid, signal.SIGCONT)
+        except OSError:
+            pass
+
+    t = _threading.Timer(duration_s, _resume)
+    t.daemon = True
+    t.start()
+    return True
+
+
 class ProcessRunnerPool(RunnerPool):
     """One OS process per runner. ``train_fn`` must be module-level picklable
     (declarative specs travel; closures need ThreadRunnerPool)."""
@@ -238,6 +274,11 @@ class ProcessRunnerPool(RunnerPool):
             if p.is_alive():
                 p.kill()
                 return True
+        return False
+
+    def stall_worker(self, partition_id: int, duration_s: float) -> bool:
+        if 0 <= partition_id < len(self._procs):
+            return _stall_process(self._procs[partition_id], duration_s)
         return False
 
     def run(self, worker_fn: Callable[[int], None]) -> List[BaseException]:
@@ -388,6 +429,11 @@ class ElasticTPURunnerPool(RunnerPool):
                 entry[0].kill()
                 return True
         return False
+
+    def stall_worker(self, partition_id: int, duration_s: float) -> bool:
+        with self._lock:
+            entry = self._procs.get(partition_id)
+        return bool(entry) and _stall_process(entry[0], duration_s)
 
     def terminate(self) -> None:
         with self._lock:

@@ -93,6 +93,11 @@ def render_telem(snap: Dict[str, Any]) -> str:
         "early-stop reaction: {}".format(
             _fmt_dist(spans.get("early_stop_reaction") or {})),
     ]
+    if (spans.get("requeue_recovery") or {}).get("n"):
+        # Only shown when recovery actually happened: a healthy run has
+        # no requeues and the line would be noise.
+        lines.append("requeue recovery: {}".format(
+            _fmt_dist(spans["requeue_recovery"])))
     hists = (snap.get("metrics") or {}).get("histograms") or {}
     rpc = sorted(((name, h) for name, h in hists.items()
                   if name.startswith("rpc.handle_ms.")),

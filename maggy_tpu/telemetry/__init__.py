@@ -63,6 +63,11 @@ class Telemetry:
         # bounds a monitor poller's cost to one derivation/second no
         # matter how long the sweep or how fast the polls.
         self._derive_cache = (0.0, -1, {})
+        # Optional phase-transition listener, set by the chaos engine when
+        # armed (on-state-transition fault triggers). Telemetry knows
+        # nothing about chaos semantics — it just forwards journaled
+        # trial-phase occurrences.
+        self.chaos_hook = None
 
     # ------------------------------------------------------------ recording
 
@@ -83,6 +88,12 @@ class Telemetry:
         self._record({"t": t, "ev": "trial", "trial": trial_id,
                       "span": span_id, "phase": phase, **fields})
         self.metrics.counter("trial.phase.{}".format(phase)).inc()
+        hook = self.chaos_hook
+        if hook is not None:
+            try:
+                hook(trial_id, phase, fields.get("partition"))
+            except Exception:  # noqa: BLE001 - chaos must never break telemetry
+                pass
         return span_id
 
     def event(self, ev: str, **fields: Any) -> None:

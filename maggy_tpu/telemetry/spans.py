@@ -25,8 +25,11 @@ from typing import Any, Dict, List, Optional
 
 #: Trial phases in nominal order (a requeued trial may revisit phases; the
 #: journal records every occurrence, derivation picks the appropriate one).
+#: ``requeued`` marks a trial re-entering the schedule after runner loss /
+#: blacklist — the explicit edge recovery latency derives from (the span's
+#: first-occurrence timestamps alone cannot carry it).
 PHASES = ("queued", "assigned", "running", "first_metric",
-          "stop_flagged", "stop_sent", "finalized", "lost")
+          "stop_flagged", "stop_sent", "finalized", "lost", "requeued")
 
 #: Gaps at or above this bound are scheduling (a runner idling on purpose at
 #: a rung barrier), not hand-off overhead — excluded from the gap stats.
@@ -126,12 +129,17 @@ def derive(events: List[Dict[str, Any]],
     - ``early_stop_reaction``: ``stop_flagged`` (driver armed the flag) to
       that trial's ``finalized`` (runner confirmed the stop) — how fast an
       early-stop frees its runner.
+    - ``requeue_recovery``: each ``requeued`` occurrence to the SAME
+      trial's next ``assigned`` — how fast a lost trial re-enters a
+      runner (the recovery-latency edge chaos soaks assert on).
     - ``trials``: lifecycle counts.
     """
     by_partition: Dict[int, List[tuple]] = {}
     stop_flagged: Dict[str, float] = {}
     finalized_at: Dict[str, float] = {}
-    finalized = errors = lost = 0
+    requeued_at: Dict[str, List[float]] = {}
+    assigned_at: Dict[str, List[float]] = {}
+    finalized = errors = lost = requeues = 0
     # Distinct trials, not 'queued' events: a resumed experiment's
     # continuous journal re-queues in-flight trials, and double-counting
     # them would overstate the schedule.
@@ -149,10 +157,15 @@ def derive(events: List[Dict[str, Any]],
             pid = ev.get("partition")
             if pid is not None:
                 by_partition.setdefault(int(pid), []).append(("run", t, trial))
+        elif phase == "assigned":
+            assigned_at.setdefault(trial, []).append(t)
         elif phase == "stop_flagged":
             stop_flagged.setdefault(trial, t)
         elif phase == "lost":
             lost += 1
+        elif phase == "requeued":
+            requeues += 1
+            requeued_at.setdefault(trial, []).append(t)
         elif phase == "finalized":
             finalized += 1
             if ev.get("error"):
@@ -178,10 +191,18 @@ def derive(events: List[Dict[str, Any]],
     reactions = [(finalized_at[tid] - t0) * 1e3
                  for tid, t0 in stop_flagged.items()
                  if tid in finalized_at and finalized_at[tid] >= t0]
+    recoveries: List[float] = []
+    for tid, times in requeued_at.items():
+        marks = sorted(assigned_at.get(tid, []))
+        for t0 in times:
+            nxt = next((t for t in marks if t >= t0), None)
+            if nxt is not None:
+                recoveries.append((nxt - t0) * 1e3)
     return {
         "trials": {"created": len(created), "finalized": finalized,
                    "early_stopped": len(early), "errors": errors,
-                   "lost": lost},
+                   "lost": lost, "requeued": requeues},
         "handoff": _dist_stats(gaps),
         "early_stop_reaction": _dist_stats(reactions),
+        "requeue_recovery": _dist_stats(recoveries),
     }

@@ -323,7 +323,7 @@ def write_telemetry_scalars(exp_dir: str, snapshot: Dict[str, Any]) -> None:
     package is absent."""
     spans = (snapshot or {}).get("spans") or {}
     scalars: Dict[str, float] = {}
-    for group in ("handoff", "early_stop_reaction"):
+    for group in ("handoff", "early_stop_reaction", "requeue_recovery"):
         stats = spans.get(group) or {}
         for key in ("median_ms", "p95_ms", "n"):
             if stats.get(key) is not None:

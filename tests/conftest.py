@@ -30,6 +30,11 @@ def pytest_configure(config):
         "markers",
         "timeout(seconds): hard SIGALRM bound — the test FAILS with a "
         "TimeoutError instead of silently eating a CI budget")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests (maggy_tpu.chaos). The deterministic "
+        "single-process smoke stays in the fast lane; the multi-process "
+        "soak is additionally marked slow. Select with -m chaos.")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,611 @@
+"""Chaos engine: plan determinism, each injector kind in isolation, the
+journal-checked soak invariants end-to-end, and regression pins for the
+satellite fixes that shipped with the subsystem (jittered client backoff +
+retry counters, the explicit `requeued` span event, config-level
+heartbeat-loss shape, and the retried-FINAL assignment wipe the sever_conn
+fault surfaced)."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from maggy_tpu import OptimizationConfig, Searchspace, experiment
+from maggy_tpu.chaos import (ChaosEngine, ChaosKilled, FaultPlan, FaultSpec,
+                             arm, disarm)
+from maggy_tpu.chaos.harness import (check_invariants, default_plan,
+                                     run_soak)
+from maggy_tpu.core import rpc
+from maggy_tpu.core.environment import EnvSing
+from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+from maggy_tpu.core.rpc import Client, Reservations
+from maggy_tpu.core.runner_pool import ThreadRunnerPool
+from maggy_tpu.telemetry import derive
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def local_env(tmp_path):
+    env = LocalEnv(base_dir=str(tmp_path / "exp"))
+    EnvSing.set_instance(env)
+    yield env
+    EnvSing.reset()
+
+
+@pytest.fixture(autouse=True)
+def no_stale_engine():
+    """Every test starts and ends unarmed — a leaked engine would inject
+    faults into unrelated tests' experiments."""
+    disarm()
+    yield
+    disarm()
+
+
+# --------------------------------------------------------------------- plans
+
+
+class TestFaultPlan:
+    def test_json_round_trip(self):
+        plan = default_plan(seed=5)
+        clone = FaultPlan.from_json(plan.to_json())
+        assert clone.seed == 5
+        assert [s.to_dict() for s in clone.specs] == \
+            [s.to_dict() for s in plan.specs]
+
+    def test_same_seed_identical_schedule(self):
+        # The acceptance contract: same plan + same seed => the same fault
+        # schedule, byte for byte.
+        assert default_plan(seed=7).fingerprint() == \
+            default_plan(seed=7).fingerprint()
+
+    def test_different_seed_different_decisions(self):
+        f7 = default_plan(seed=7).fingerprint(draws=256)
+        f8 = default_plan(seed=8).fingerprint(draws=256)
+        decisions = [e["decisions"] for e in f7 if "decisions" in e]
+        assert decisions and any(True in d or False in d for d in decisions)
+        assert f7 != f8
+
+    def test_unknown_kind_and_trigger_rejected(self):
+        with pytest.raises(ValueError, match="Unknown fault kind"):
+            FaultSpec("explode", trigger={"nth": 1})
+        with pytest.raises(ValueError, match="Unknown trigger"):
+            FaultSpec("drop_msg", trigger={"whenever": True})
+        with pytest.raises(ValueError, match="needs a trigger"):
+            FaultSpec("drop_msg")
+
+    def test_never_firing_combinations_rejected(self):
+        # A spec no hook site evaluates would make the plan a silent
+        # no-op (soak passes with zero injections) — reject at build.
+        with pytest.raises(ValueError, match="runner fault"):
+            FaultSpec("kill_runner", trigger={"probability": 0.5})
+        with pytest.raises(ValueError, match="runner fault"):
+            FaultSpec("stall_runner", trigger={"nth": 2})
+        with pytest.raises(ValueError, match="per-occurrence fault"):
+            FaultSpec("drop_msg", trigger={"after_s": 5.0})
+        with pytest.raises(ValueError, match="not a span phase"):
+            FaultSpec("kill_runner", trigger={"on_phase": "runing"})
+
+    def test_ambiguous_triggers_rejected(self):
+        # Exactly one trigger (silent precedence would betray the plan
+        # author); on_phase+nth is the single documented combination.
+        with pytest.raises(ValueError, match="ambiguous"):
+            FaultSpec("drop_msg", trigger={"nth": 3, "probability": 0.5})
+        FaultSpec("kill_runner", trigger={"on_phase": "running", "nth": 2})
+
+    def test_timed_runner_fault_requires_partition(self):
+        with pytest.raises(ValueError, match="target.partition"):
+            FaultSpec("kill_runner", trigger={"after_s": 2.0})
+        FaultSpec("kill_runner", target={"partition": 1},
+                  trigger={"after_s": 2.0})
+
+    def test_load_through_env(self, local_env, tmp_path):
+        path = str(tmp_path / "plan.json")
+        local_env.dump(default_plan(seed=3).to_json(), path)
+        assert FaultPlan.load(path, env=local_env).seed == 3
+        assert FaultPlan.load(path).seed == 3  # plain-fs fallback
+
+
+# ----------------------------------------------------------------- injectors
+
+
+def _engine(*specs, seed=0):
+    return ChaosEngine(FaultPlan(list(specs), seed=seed))
+
+
+class TestInjectorKinds:
+    def test_drop_msg_probability_matches_fingerprint(self):
+        plan = FaultPlan([FaultSpec("drop_msg", target={"verb": "METRIC"},
+                                    trigger={"probability": 0.3})], seed=9)
+        engine = ChaosEngine(plan)
+        decisions = [engine.on_server_message(
+            {"type": "METRIC", "partition_id": 0}) is not None
+            for _ in range(64)]
+        # The engine's live decisions ARE the plan's pure expansion.
+        assert decisions == plan.fingerprint(draws=64)[0]["decisions"]
+        # Non-matching verbs never consume a draw.
+        assert engine.on_server_message({"type": "GET"}) is None
+
+    def test_delay_and_sever_actions(self):
+        engine = _engine(
+            FaultSpec("delay_msg", target={"verb": "FINAL"},
+                      trigger={"nth": 1}, delay_s=0.25),
+            FaultSpec("sever_conn", target={"verb": "GET"},
+                      trigger={"every_nth": 2}),
+        )
+        assert engine.on_server_message({"type": "FINAL"}) == ("delay", 0.25)
+        # nth matches exactly the Nth occurrence, not every one after it.
+        assert engine.on_server_message({"type": "FINAL"}) is None
+        assert engine.on_server_message({"type": "GET"}) is None
+        assert engine.on_server_message({"type": "GET"}) == ("sever",)
+
+    def test_partition_target_filters(self):
+        engine = _engine(FaultSpec("drop_msg",
+                                   target={"verb": "METRIC", "partition": 1},
+                                   trigger={"every_nth": 1}))
+        assert engine.on_server_message(
+            {"type": "METRIC", "partition_id": 0}) is None
+        assert engine.on_server_message(
+            {"type": "METRIC", "partition_id": 1}) == ("drop",)
+
+    def test_cooperative_kill_raises_chaos_killed(self):
+        engine = _engine(FaultSpec("kill_runner",
+                                   trigger={"on_phase": "running"}))
+        engine.on_trial_phase("t1", "running", partition=2)
+        assert engine.injected[0]["kind"] == "kill_runner"
+        assert engine.injected[0]["trial"] == "t1"
+        with pytest.raises(ChaosKilled):
+            engine.on_client_request({"type": "GET", "partition_id": 2})
+        # Other partitions are untouched.
+        engine.on_client_request({"type": "GET", "partition_id": 0})
+
+    def test_chaos_killed_is_connection_error(self):
+        # The heartbeat loop swallows ConnectionError: a condemned
+        # runner's beats must go SILENT, not crash the beat thread.
+        assert issubclass(ChaosKilled, ConnectionError)
+
+    def test_cooperative_stall_blocks_then_releases(self):
+        engine = _engine(FaultSpec("stall_runner", target={"partition": 0},
+                                   trigger={"on_phase": "running"},
+                                   duration_s=0.2))
+        engine.on_trial_phase("t1", "running", partition=0)
+        t0 = time.monotonic()
+        engine.on_client_request({"type": "METRIC", "partition_id": 0})
+        assert time.monotonic() - t0 >= 0.15
+        # Expired: subsequent requests pass immediately.
+        t1 = time.monotonic()
+        engine.on_client_request({"type": "METRIC", "partition_id": 0})
+        assert time.monotonic() - t1 < 0.1
+
+    def test_fake_preemption_ages_and_mutes_heartbeats(self):
+        res = Reservations(1)
+        res.add({"partition_id": 0})
+        engine = _engine(FaultSpec("fake_preemption", target={"partition": 0},
+                                   trigger={"on_phase": "first_metric"},
+                                   duration_s=0.3))
+        engine.attach(reservations=res)
+        assert not res.is_silent(0, 1.0)
+        engine.on_trial_phase("t1", "first_metric", partition=0)
+        assert res.is_silent(0, 1.0)
+        # Fresh beats are muted for duration_s: silence STICKS long enough
+        # for the loss scan to observe it.
+        res.touch(0)
+        assert res.is_silent(0, 1.0)
+        time.sleep(0.35)
+        res.touch(0)
+        assert not res.is_silent(0, 1.0)
+
+    def test_fake_preemption_suppresses_loss_reap(self):
+        # The faked-lost runner is HEALTHY: the driver's heartbeat-loss
+        # reap must leave it alive (to deliver the duplicate FINAL), and
+        # the suppression must expire with the fault window.
+        res = Reservations(1)
+        res.add({"partition_id": 0})
+        engine = _engine(FaultSpec("fake_preemption", target={"partition": 0},
+                                   trigger={"on_phase": "first_metric"},
+                                   duration_s=0.2))
+        engine.attach(reservations=res)
+        assert not engine.suppress_reap(0)
+        engine.on_trial_phase("t1", "first_metric", partition=0)
+        assert engine.suppress_reap(0)
+        assert not engine.suppress_reap(1)
+        time.sleep(0.25)
+        assert not engine.suppress_reap(0)
+
+    def test_env_write_fail_never_hits_the_journal(self, local_env,
+                                                   tmp_path):
+        # A match-anything env fault must not destroy the telemetry
+        # journal — the artifact the soak invariants are checked against.
+        from maggy_tpu.telemetry import Telemetry
+
+        jpath = str(tmp_path / "exp" / "telemetry.jsonl")
+        telem = Telemetry(env=local_env, journal_path=jpath)
+        try:
+            engine = ChaosEngine(
+                FaultPlan([FaultSpec("env_write_fail",
+                                     trigger={"every_nth": 1})], seed=0),
+                telemetry=telem)
+            arm(engine)
+            local_env.dump("{}", jpath)  # journal flush path: exempt
+            with pytest.raises(OSError, match="chaos"):
+                local_env.dump("{}", str(tmp_path / "exp" / "other.json"))
+        finally:
+            disarm()
+            telem.close()
+
+    def test_env_write_fail_is_transient(self, local_env, tmp_path):
+        engine = _engine(FaultSpec("env_write_fail",
+                                   target={"path": ".hparams"},
+                                   trigger={"nth": 1}, count=1))
+        arm(engine)
+        target = str(tmp_path / "x" / ".hparams.json")
+        with pytest.raises(OSError, match="chaos"):
+            local_env.dump("{}", target)
+        # Unmatched paths never failed; the matched one succeeds on retry.
+        local_env.dump("{}", str(tmp_path / "x" / "other.json"))
+        local_env.dump("{}", target)
+        assert engine.injected[0]["kind"] == "env_write_fail"
+
+    def test_kill_runner_prefers_pool_kill(self):
+        class FakePool(ThreadRunnerPool):
+            def __init__(self):
+                super().__init__(1)
+                self.killed = []
+
+            def kill_worker(self, pid):
+                self.killed.append(pid)
+                return True
+
+        pool = FakePool()
+        engine = _engine(FaultSpec("kill_runner", target={"partition": 3},
+                                   trigger={"after_s": 0.0}))
+        engine.attach(pool=pool)
+        engine.tick()
+        assert pool.killed == [3]
+        assert engine.injected[0]["mechanism"] == "sigkill"
+        # One-shot: another tick must not re-fire.
+        engine.tick()
+        assert len(engine.injected) == 1
+
+    def test_thread_pool_cannot_stall(self):
+        assert ThreadRunnerPool(2).stall_worker(0, 0.1) is False
+
+    def test_partitionless_phase_event_does_not_misfire(self):
+        # Phase events journaled without a partition (queued,
+        # stop_flagged) cannot target a runner: the fault must neither
+        # land on an arbitrary partition nor consume the nth occurrence.
+        engine = _engine(FaultSpec("kill_runner",
+                                   trigger={"on_phase": "running",
+                                            "nth": 1}))
+        engine.on_trial_phase("t1", "running", partition=None)
+        assert engine.injected == []
+        engine.on_trial_phase("t2", "running", partition=1)
+        assert [e["partition"] for e in engine.injected] == [1]
+
+    def test_after_s_rearms_per_interval(self):
+        engine = _engine(FaultSpec("fake_preemption",
+                                   target={"partition": 0},
+                                   trigger={"after_s": 3600.0}, count=3))
+        engine._t0 -= 3700.0  # one interval elapsed, not two
+        engine.tick()
+        engine.tick()  # next deadline is 7200s: must NOT burst-fire
+        assert len(engine.injected) == 1
+
+    def test_timed_fault_journals_the_held_trial(self):
+        # A timed kill has no phase event naming its victim: the engine
+        # resolves the trial the partition holds so the harness's
+        # fault->requeue invariant covers timed kills too.
+        res = Reservations(1)
+        res.add({"partition_id": 2})
+        res.assign_trial(2, "t_held")
+        engine = _engine(FaultSpec("kill_runner", target={"partition": 2},
+                                   trigger={"after_s": 0.0}))
+        engine.attach(reservations=res)
+        engine.tick()
+        assert engine.injected[0]["trial"] == "t_held"
+
+
+# --------------------------------------------------- satellite regressions
+
+
+class TestClientBackoff:
+    """Satellite: jittered exponential backoff (capped) + retry/reconnect
+    counters in the client metrics registry."""
+
+    def _flaky_server(self):
+        """Listener that accepts and immediately closes every connection."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(8)
+        stop = threading.Event()
+
+        def loop():
+            while not stop.is_set():
+                try:
+                    srv.settimeout(0.2)
+                    conn, _ = srv.accept()
+                    conn.close()
+                except OSError:
+                    continue
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return srv, stop
+
+    def test_retries_exhaust_with_counted_backoff(self, monkeypatch):
+        from maggy_tpu import constants
+
+        srv, stop = self._flaky_server()
+        delays = []
+        real_sleep = time.sleep
+        monkeypatch.setattr(rpc.time, "sleep",
+                            lambda s: (delays.append(s), real_sleep(0))[1])
+        try:
+            client = Client(srv.getsockname(), partition_id=0,
+                            task_attempt=0, hb_interval=1.0, secret="s")
+            r0 = rpc.CLIENT_METRICS.counter("rpc.client.retries").value
+            c0 = rpc.CLIENT_METRICS.counter("rpc.client.reconnects").value
+            with pytest.raises(ConnectionError, match="after retries"):
+                client._request({"type": "QUERY"})
+            assert rpc.CLIENT_METRICS.counter("rpc.client.retries").value \
+                == r0 + constants.CLIENT_MAX_RETRIES
+            assert rpc.CLIENT_METRICS.counter("rpc.client.reconnects").value \
+                == c0 + constants.CLIENT_MAX_RETRIES
+            # Jittered exponential: each delay within [base/2, cap], and
+            # the backoff ceiling grows (attempt k's delay can reach
+            # base*2^k but never the cap's double).
+            assert len(delays) == constants.CLIENT_MAX_RETRIES
+            for i, d in enumerate(delays):
+                lo = constants.CLIENT_RETRY_BACKOFF_BASE_S * (2 ** i) / 2
+                hi = min(constants.CLIENT_RETRY_BACKOFF_BASE_S * (2 ** i),
+                         constants.CLIENT_RETRY_BACKOFF_CAP_S)
+                assert lo <= d <= hi, (i, d)
+        finally:
+            stop.set()
+            srv.close()
+
+
+class TestRequeuedSpanEvent:
+    """Satellite: the explicit `requeued` event makes recovery latency
+    derivable from the journal."""
+
+    def test_derive_requeue_recovery(self):
+        events = [
+            {"t": 10.0, "ev": "trial", "trial": "a", "phase": "queued"},
+            {"t": 10.1, "ev": "trial", "trial": "a", "phase": "assigned",
+             "partition": 0},
+            {"t": 12.0, "ev": "trial", "trial": "a", "phase": "lost",
+             "partition": 0},
+            {"t": 12.0, "ev": "trial", "trial": "a", "phase": "requeued",
+             "partition": 0, "reason": "heartbeat_loss"},
+            {"t": 12.5, "ev": "trial", "trial": "a", "phase": "assigned",
+             "partition": 1, "requeue": "backlog"},
+            {"t": 13.0, "ev": "trial", "trial": "a", "phase": "finalized",
+             "partition": 1},
+        ]
+        out = derive(events)
+        assert out["trials"]["requeued"] == 1
+        assert out["requeue_recovery"]["n"] == 1
+        assert out["requeue_recovery"]["median_ms"] == pytest.approx(500.0)
+
+    def test_requeued_in_phases(self):
+        from maggy_tpu.telemetry import PHASES
+
+        assert "requeued" in PHASES
+
+
+class TestHbLossConfigFields:
+    """Satellite: HEARTBEAT_LOSS_FACTOR / MIN promoted to config fields."""
+
+    def test_fields_shape_the_loss_timeout(self, local_env):
+        from maggy_tpu.core.driver.optimization_driver import \
+            OptimizationDriver
+
+        config = OptimizationConfig(
+            name="hb_fields", num_trials=1, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 1.0])),
+            num_workers=1, hb_interval=0.1, seed=1, es_policy="none",
+            hb_loss_min_s=0.4, hb_loss_factor=2.0,
+        )
+        driver = OptimizationDriver(config, "hbapp", 0)
+        try:
+            # max(0.4, 0.1 * 2.0) — the config fields, not the globals.
+            assert driver.server.hb_loss_timeout == pytest.approx(0.4)
+        finally:
+            driver.stop()
+
+    def test_explicit_timeout_still_wins(self, local_env):
+        from maggy_tpu.core.driver.optimization_driver import \
+            OptimizationDriver
+
+        config = OptimizationConfig(
+            name="hb_explicit", num_trials=1, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 1.0])),
+            num_workers=1, hb_interval=0.1, seed=1, es_policy="none",
+            hb_loss_timeout=7.5, hb_loss_min_s=0.1,
+        )
+        driver = OptimizationDriver(config, "hbapp2", 0)
+        try:
+            assert driver.server.hb_loss_timeout == 7.5
+        finally:
+            driver.stop()
+
+
+class TestChaosArming:
+    def test_chaos_with_telemetry_off_fails_loudly(self, local_env):
+        # Without telemetry there are no phase events and no journal:
+        # the plan would be a silent no-op and the soak would "pass".
+        from maggy_tpu.core.driver.optimization_driver import \
+            OptimizationDriver
+
+        config = OptimizationConfig(
+            name="chaos_no_telem", num_trials=1, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 1.0])),
+            num_workers=1, seed=1, es_policy="none", telemetry=False,
+            chaos=default_plan(1),
+        )
+        with pytest.raises(ValueError, match="telemetry=True"):
+            OptimizationDriver(config, "chaosapp", 0)
+
+    def test_inert_plan_fails_the_soak(self, tmp_path):
+        # A plan whose specs never match must not report the invariants
+        # as verified — zero injections means zero coverage.
+        plan = FaultPlan([FaultSpec("drop_msg", target={"verb": "NOPE"},
+                                    trigger={"probability": 1.0})], seed=1)
+        report = run_soak(plan=plan, seed=1, num_trials=3, workers=2,
+                          base_dir=str(tmp_path / "inert"))
+        assert not report["ok"]
+        assert any("no faults injected" in v for v in report["violations"])
+
+
+class TestRetriedFinalDoesNotWipeAssignment:
+    """Regression for the bug the sever_conn fault surfaced: a RETRIED
+    FINAL (at-least-once delivery) arriving after the driver assigned the
+    partition its next trial must not wipe that assignment — the wipe
+    stranded the trial in the store and hung the experiment."""
+
+    def test_clear_trial_if_is_conditional(self):
+        res = Reservations(1)
+        res.add({"partition_id": 0})
+        res.assign_trial(0, "old")
+        res.clear_trial_if(0, "old")
+        assert res.get_assigned_trial(0) is None
+        # Driver hands the partition its next trial; the retried FINAL
+        # for "old" must leave it untouched.
+        res.assign_trial(0, "next")
+        res.clear_trial_if(0, "old")
+        assert res.get_assigned_trial(0) == "next"
+
+
+# ------------------------------------------------------------------ invariants
+
+
+class TestCheckInvariants:
+    def test_clean_journal_passes(self):
+        events = [
+            {"t": 1.0, "ev": "trial", "trial": "a", "phase": "queued"},
+            {"t": 2.0, "ev": "trial", "trial": "a", "phase": "finalized"},
+            {"t": 3.0, "ev": "experiment", "phase": "finalized"},
+        ]
+        report = check_invariants(events)
+        assert report["ok"] and not report["violations"]
+
+    def test_lost_trial_and_duplicate_final_flagged(self):
+        events = [
+            {"t": 1.0, "ev": "trial", "trial": "a", "phase": "queued"},
+            {"t": 1.0, "ev": "trial", "trial": "b", "phase": "queued"},
+            {"t": 2.0, "ev": "trial", "trial": "b", "phase": "finalized"},
+            {"t": 2.5, "ev": "trial", "trial": "b", "phase": "finalized"},
+            {"t": 3.0, "ev": "experiment", "phase": "end"},
+        ]
+        report = check_invariants(events)
+        assert not report["ok"]
+        assert any("lost trial: a" in v for v in report["violations"])
+        assert any("duplicate FINAL: b" in v for v in report["violations"])
+
+    def test_unrequeued_kill_flagged_and_latency_measured(self):
+        base = [
+            {"t": 1.0, "ev": "trial", "trial": "a", "phase": "queued"},
+            {"t": 1.5, "ev": "chaos", "kind": "kill_runner", "trial": "a",
+             "partition": 0},
+            {"t": 2.0, "ev": "trial", "trial": "a", "phase": "finalized"},
+            {"t": 3.0, "ev": "experiment", "phase": "end"},
+        ]
+        report = check_invariants(base)
+        assert any("no requeue" in v for v in report["violations"])
+        healed = base[:2] + [
+            {"t": 2.2, "ev": "trial", "trial": "a", "phase": "requeued"},
+            {"t": 2.6, "ev": "trial", "trial": "a", "phase": "finalized"},
+            {"t": 3.0, "ev": "experiment", "phase": "end"},
+        ]
+        report = check_invariants(healed, requeue_bound_s=1.0)
+        assert report["ok"]
+        assert report["recoveries"][0]["requeue_latency_s"] == \
+            pytest.approx(0.7)
+        report = check_invariants(healed, requeue_bound_s=0.5)
+        assert any("slow requeue" in v for v in report["violations"])
+
+
+# ------------------------------------------------------------------ e2e soak
+
+
+@pytest.mark.timeout(120)
+class TestDeterministicSmokeSoak:
+    """The fast-lane chaos smoke: single process, thread pool, the
+    standard plan (kill mid-trial + false preemption + 5% METRIC drops +
+    severed FINAL replies) against a real lagom run."""
+
+    def test_soak_invariants_hold(self, tmp_path):
+        report = run_soak(seed=7, num_trials=10, workers=3,
+                          base_dir=str(tmp_path / "soak"))
+        assert report["ok"], report["violations"]
+        assert report["trials"]["queued"] == 10
+        assert report["trials"]["finalized"] == 10
+        # >= 3 fault kinds actually injected, including the mid-trial kill.
+        assert len(report["faults"]["by_kind"]) >= 3
+        assert report["faults"]["by_kind"].get("kill_runner") == 1
+        # Every injected kill has a measured fault->requeue latency, and
+        # no runner-death fault went unrecovered (a fake preemption may
+        # benignly lose the race to a fast trial's FINAL instead).
+        kills = [r for r in report["recoveries"]
+                 if r["kind"] == "kill_runner"]
+        assert kills and all(r["requeue_latency_s"] is not None
+                             for r in kills)
+        assert all(r["outcome"] != "unrecovered"
+                   for r in report["recoveries"])
+        # The drops/severs exercised the client retry machinery.
+        assert report["client_retries"] > 0
+        # Same plan + seed => identical schedule expansion.
+        assert report["schedule_fingerprint"] == \
+            default_plan(seed=7).fingerprint()
+
+    def test_engine_disarmed_after_soak(self, tmp_path):
+        from maggy_tpu.chaos import active_engine
+
+        run_soak(seed=3, num_trials=4, workers=2,
+                 base_dir=str(tmp_path / "soak2"))
+        assert active_engine() is None
+
+
+def train_process_soak(lr, units, reporter=None):
+    """Module-level (spawn-picklable) soak trial for the process pool."""
+    acc = 1.0 - ((lr - 0.1) ** 2 + ((units - 32) / 64.0) ** 2)
+    for step in range(8):
+        time.sleep(0.25)
+        if reporter is not None:
+            reporter.broadcast(acc * (step + 1) / 8.0, step=step)
+    return {"metric": acc}
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(300)
+class TestMultiProcessSoak:
+    """The multi-process soak: a REAL SIGKILL mid-trial (the pool kills
+    the runner process), heartbeat-loss requeue across OS processes, and
+    the same journal invariants."""
+
+    def test_sigkill_soak(self, tmp_path):
+        plan = FaultPlan([
+            FaultSpec("kill_runner", trigger={"on_phase": "running",
+                                              "nth": 3}),
+            FaultSpec("drop_msg", target={"verb": "METRIC"},
+                      trigger={"probability": 0.05}),
+        ], seed=11)
+        report = run_soak(plan=plan, seed=11,
+                          train_fn=train_process_soak, num_trials=6,
+                          workers=2, pool="process", hb_interval=0.2,
+                          hb_loss_timeout=2.0,
+                          base_dir=str(tmp_path / "psoak"))
+        assert report["ok"], report["violations"]
+        assert report["trials"]["finalized"] == 6
+        kill = [r for r in report["recoveries"]
+                if r["kind"] == "kill_runner"][0]
+        assert kill["requeue_latency_s"] is not None
+        # The kill was a real SIGKILL, not the cooperative fallback.
+        events = [json.loads(line)
+                  for line in open(report["journal"])]
+        chaos = [e for e in events if e.get("ev") == "chaos"
+                 and e.get("kind") == "kill_runner"]
+        assert chaos and chaos[0]["mechanism"] == "sigkill"
