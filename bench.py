@@ -521,6 +521,7 @@ def headline_main():
             sched["early_stop_reaction"]["median_ms"],
             sched["early_stop_reaction"]["p95_ms"],
             sched["early_stop_reaction"]["n"]))
+    trace_path = _export_trace_artifact(exp_dirs[-1])
 
     # Two interleaved runs per baseline, keeping each baseline's MIN wall:
     # sustained-load drift (host thermal/noisy-neighbor — measured +12%
@@ -554,9 +555,33 @@ def headline_main():
             "handoff": handoff,
             "early_stop_reaction": sched["early_stop_reaction"],
             "handoff_source": sched["source"],
+            "trace": trace_path,
         },
     }), flush=True)
     return 0
+
+
+def _export_trace_artifact(exp_dir):
+    """Export the sweep's Perfetto timeline next to its journal and return
+    its path — but ONLY after re-reading the written file and validating
+    it parses as Chrome-trace JSON: a path recorded in a BENCH artifact
+    must point at something a human can actually load."""
+    from maggy_tpu.telemetry import JOURNAL_NAME, read_events
+    from maggy_tpu.telemetry.trace import validate_trace, write_trace
+
+    journal = os.path.join(exp_dir, JOURNAL_NAME)
+    if not os.path.exists(journal):
+        return None
+    trace_path = os.path.join(exp_dir, "trace.json")
+    try:
+        n = write_trace(read_events(journal), trace_path)
+        with open(trace_path) as f:
+            validate_trace(json.load(f))
+    except Exception as e:  # noqa: BLE001 - the artifact is best-effort
+        log("trace export failed (not recorded): {!r}".format(e))
+        return None
+    log("trace: {} events -> {} (perfetto-loadable)".format(n, trace_path))
+    return trace_path
 
 
 def chaos_main():
@@ -587,8 +612,13 @@ def chaos_main():
             "faults": report["faults"],
             "recoveries": report["recoveries"],
             "trials": report["trials"],
+            "health": report.get("health"),
             "client_retries": report["client_retries"],
             "journal": report["journal"],
+            # The soak timeline (chaos injections + health flags as
+            # instant markers): validated perfetto-loadable or None.
+            "trace": _export_trace_artifact(
+                os.path.dirname(report["journal"])),
         },
     }), flush=True)
     return 0 if report["ok"] else 1

@@ -8,7 +8,12 @@ the standard soak runs: a runner killed mid-trial, a false preemption,
 
     python -m maggy_tpu.chaos --seed 7
     python -m maggy_tpu.chaos --plan my_plan.json --trials 20 --workers 4
+    python -m maggy_tpu.chaos --stall                    # health-engine soak
     python -m maggy_tpu.chaos --show-schedule --seed 7   # no experiment
+
+``--stall`` runs the straggler soak instead: one runner frozen mid-trial
+below the heartbeat-loss bound, asserting the live health engine flags
+it (invariant 5, docs/telemetry.md).
 
 ``--show-schedule`` prints the plan's deterministic decision expansion
 (the fingerprint): run it twice with the same seed and diff the output to
@@ -37,9 +42,16 @@ def main(argv=None) -> int:
     ap.add_argument("--pool", default="thread",
                     choices=["thread", "process"],
                     help="runner substrate (process = real SIGKILL/SIGSTOP)")
-    ap.add_argument("--hb-loss-timeout", type=float, default=0.6,
+    ap.add_argument("--hb-loss-timeout", type=float, default=None,
                     help="seconds of heartbeat silence before a runner is "
-                         "declared lost")
+                         "declared lost (default 0.6; with --stall the "
+                         "default rises to 10 so the loss scan stays "
+                         "blind to the stall — an explicit value is "
+                         "honored either way)")
+    ap.add_argument("--stall", action="store_true",
+                    help="run the straggler soak: a runner stalled below "
+                         "the loss bound; the health engine must flag it "
+                         "(invariant 5)")
     ap.add_argument("--show-schedule", action="store_true",
                     help="print the plan's deterministic decision "
                          "expansion and exit (no experiment)")
@@ -48,12 +60,17 @@ def main(argv=None) -> int:
     from maggy_tpu.chaos import harness
     from maggy_tpu.chaos.plan import FaultPlan
 
+    if args.plan and args.stall:
+        ap.error("--stall uses the built-in stall plan; drop --plan")
     if args.plan:
         plan = FaultPlan.load(args.plan)
         # A reproduction run must honor the plan file's embedded seed;
         # only an EXPLICIT --seed overrides it.
         if args.seed is not None:
             plan.seed = args.seed
+    elif args.stall:
+        plan = harness.stall_plan(seed=7 if args.seed is None
+                                  else args.seed)
     else:
         plan = harness.default_plan(seed=7 if args.seed is None
                                     else args.seed)
@@ -68,10 +85,21 @@ def main(argv=None) -> int:
         train_fn = harness._soak_train_fn
     else:
         train_fn = None
+    hb_loss = args.hb_loss_timeout
+    soak_kwargs = dict(hb_loss_timeout=0.6 if hb_loss is None else hb_loss)
+    if args.stall:
+        # The loss scan should stay blind to the stall (that's the
+        # point), so the DEFAULT loss bound rises above the stall and
+        # the watchdog tightens — but an explicit --hb-loss-timeout is
+        # the operator's call and is honored as given.
+        soak_kwargs = dict(
+            hb_loss_timeout=10.0 if hb_loss is None else hb_loss,
+            config_overrides={"health_hang_factor": 10.0,
+                              "health_interval_s": 0.1})
     report = harness.run_soak(
         plan=plan, seed=plan.seed, train_fn=train_fn,
         num_trials=args.trials, workers=args.workers, pool=args.pool,
-        hb_loss_timeout=args.hb_loss_timeout)
+        **soak_kwargs)
     print(json.dumps(report, indent=2, default=str))
     return 0 if report["ok"] else 1
 

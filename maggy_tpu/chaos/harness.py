@@ -18,6 +18,15 @@ checkable against any soak artifact after the fact):
     is measured and reported.
 4.  **Experiment completes** — the journal carries the experiment's
     ``finalized`` lifecycle event.
+5.  **Stall is flagged** — every injected ``stall_runner`` fault is
+    followed by a health-engine ``raised`` event (hang or straggler, see
+    telemetry/health.py) for the stalled partition within the bound
+    (startup_factor x hang threshold + 2 health-check intervals + 3 s
+    grace — the worst case: a stall landing on a still-compiling trial
+    is judged at the longer startup leash). This is the closed loop
+    between PR 2's fault injection and this PR's live health monitoring:
+    a stall the heartbeat-loss scan is too coarse to see must still
+    surface.
 """
 
 from __future__ import annotations
@@ -51,6 +60,18 @@ def default_plan(seed: int = 7) -> FaultPlan:
     ], seed=seed)
 
 
+def stall_plan(seed: int = 7, duration_s: float = 2.0) -> FaultPlan:
+    """One runner frozen mid-trial for ``duration_s`` — the straggler/hang
+    soak. Pair with ``hb_loss_timeout`` ABOVE the stall duration so the
+    loss scan stays blind: the stall must be caught by the health engine's
+    hang watchdog, which is exactly the invariant this plan exercises."""
+    return FaultPlan([
+        FaultSpec("stall_runner", trigger={"on_phase": "first_metric",
+                                           "nth": 2},
+                  duration_s=duration_s),
+    ], seed=seed)
+
+
 def _soak_train_fn(lr, units, reporter=None):
     """Closed-form stand-in trial: long enough (~0.3 s) that faults land
     mid-trial, heartbeating every step."""
@@ -69,12 +90,17 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
              workers: int = 3, pool: str = "thread",
              hb_interval: float = 0.05, hb_loss_timeout: float = 0.6,
              base_dir: Optional[str] = None,
-             requeue_grace_s: float = 5.0) -> Dict[str, Any]:
+             requeue_grace_s: float = 5.0,
+             config_overrides: Optional[Dict[str, Any]] = None
+             ) -> Dict[str, Any]:
     """Execute one soak and return its report (see ``check_invariants``).
 
     The experiment runs under a private base dir; the journal is read
     back from disk (NOT from the live telemetry object) so the report is
-    derived from the same artifact an offline replay would use."""
+    derived from the same artifact an offline replay would use.
+    ``config_overrides`` merges extra OptimizationConfig fields (e.g.
+    ``health_hang_factor`` to tighten the hang watchdog for a stall
+    soak)."""
     import tempfile
 
     from maggy_tpu import OptimizationConfig, Searchspace, experiment
@@ -84,7 +110,7 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
     plan = plan if plan is not None else default_plan(seed)
     train_fn = train_fn or _soak_train_fn
     base_dir = base_dir or tempfile.mkdtemp(prefix="maggy_chaos_")
-    config = OptimizationConfig(
+    kwargs = dict(
         name="chaos_soak", num_trials=num_trials, optimizer="randomsearch",
         searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2]),
                                 units=("INTEGER", [8, 64])),
@@ -93,6 +119,27 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
         seed=seed, es_policy="none", experiment_dir=base_dir,
         chaos=plan,
     )
+    kwargs.update(config_overrides or {})
+    config = OptimizationConfig(**kwargs)
+    # Bound for invariant 5 (stall -> health flag): the WORST-case hang
+    # threshold (startup window, in case the plan stalls a trial before
+    # its first metric) + health-check interval + grace for the
+    # scheduling jitter in between. Derived from the health module's own
+    # constants so the watchdog and its verifier cannot silently diverge.
+    from maggy_tpu.telemetry.health import (DEFAULT_HANG_FACTOR,
+                                            DEFAULT_STARTUP_FACTOR,
+                                            default_interval_s)
+
+    hang_s = getattr(config, "health_hang_factor",
+                     DEFAULT_HANG_FACTOR) * hb_interval
+    health_interval = getattr(config, "health_interval_s", None) \
+        or default_interval_s(hb_interval)
+    stall_flag_bound_s: Optional[float] = \
+        DEFAULT_STARTUP_FACTOR * hang_s + 2 * health_interval + 3.0
+    if not getattr(config, "health", True):
+        # No health engine, nothing can flag a stall: the invariant is
+        # vacuous, not violated.
+        stall_flag_bound_s = None
     retry0 = rpc.CLIENT_METRICS.counter("rpc.client.retries").value
     result = experiment.lagom(train_fn, config)
     retries = rpc.CLIENT_METRICS.counter("rpc.client.retries").value - retry0
@@ -101,7 +148,8 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
     journal = os.path.join(exp_dirs[-1], JOURNAL_NAME)
     events = read_events(journal)
     report = check_invariants(
-        events, requeue_bound_s=hb_loss_timeout + requeue_grace_s)
+        events, requeue_bound_s=hb_loss_timeout + requeue_grace_s,
+        stall_flag_bound_s=stall_flag_bound_s)
     # A soak that injected NOTHING verified nothing: a plan whose specs
     # never matched (wrong verb, unreachable nth) must fail loudly, not
     # report the recovery invariants as held.
@@ -138,20 +186,40 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
 
 
 def check_invariants(events: List[Dict[str, Any]],
-                     requeue_bound_s: Optional[float] = None) -> Dict[str, Any]:
+                     requeue_bound_s: Optional[float] = None,
+                     stall_flag_bound_s: Optional[float] = 15.0
+                     ) -> Dict[str, Any]:
     """Pure invariant check over journal events. Returns a report with
     ``violations`` (empty = all invariants hold), per-fault recovery
-    latencies, and lifecycle counts."""
+    latencies, health-flag stats, and lifecycle counts.
+
+    ``stall_flag_bound_s`` bounds invariant 5 (every ``stall_runner``
+    injection must be followed by a health ``raised`` flag for the stalled
+    partition). The invariant is enforced only when the journal carries
+    the health engine's ``started`` liveness marker — a pre-health or
+    ``health=False`` journal has nothing watching, which is a skipped
+    check, not a violation. Passing None also skips it."""
     queued: Dict[str, float] = {}
     finalized: Dict[str, List[float]] = {}
     requeued: Dict[str, List[float]] = {}
     chaos_events: List[Dict[str, Any]] = []
+    health_raised: List[Dict[str, Any]] = []
+    health_by_check: Dict[str, int] = {}
+    health_engine_ran = False
     experiment_finalized = False
     for ev in events:
         kind = ev.get("ev")
         t = ev.get("t")
         if kind == "chaos":
             chaos_events.append(dict(ev))
+            continue
+        if kind == "health":
+            if ev.get("check") == "engine":
+                health_engine_ran |= ev.get("status") == "started"
+            elif ev.get("status") == "raised":
+                health_raised.append(dict(ev))
+                health_by_check[ev.get("check")] = \
+                    health_by_check.get(ev.get("check"), 0) + 1
             continue
         if kind == "experiment":
             if ev.get("phase") in ("finalized", "end"):
@@ -223,6 +291,37 @@ def check_invariants(events: List[Dict[str, Any]],
                     ce["kind"], trial, ce.get("partition")))
         recoveries.append(rec)
 
+    # Invariant 5: stall -> health flag. A frozen runner shorter than the
+    # loss bound is invisible to the heartbeat-loss scan; the health
+    # engine's hang watchdog (or straggler scoring) must still see it,
+    # within bounded time, attributed to the right partition.
+    from maggy_tpu.telemetry.health import STALL_CHECKS
+
+    stall_flags: List[Dict[str, Any]] = []
+    enforce_stall = stall_flag_bound_s is not None and health_engine_ran
+    for ce in chaos_events:
+        if ce.get("kind") != "stall_runner" or not enforce_stall:
+            continue
+        pid, t0 = ce.get("partition"), ce.get("t")
+        if pid is None or t0 is None:
+            continue
+        matching = [h for h in health_raised
+                    if h.get("partition") == pid
+                    and h.get("check") in STALL_CHECKS
+                    and h.get("t") is not None
+                    and t0 <= h["t"] <= t0 + stall_flag_bound_s]
+        rec = {"partition": pid, "t": t0,
+               "flagged": bool(matching),
+               "flag_latency_s": round(min(h["t"] for h in matching) - t0, 3)
+               if matching else None,
+               "checks": sorted({h["check"] for h in matching})}
+        stall_flags.append(rec)
+        if not matching:
+            violations.append(
+                "unflagged stall: stall_runner fault on partition {} at "
+                "t={:.3f} produced no health straggler/hang flag within "
+                "{:.1f}s".format(pid, t0, stall_flag_bound_s))
+
     by_kind: Dict[str, int] = {}
     for ce in chaos_events:
         by_kind[ce["kind"]] = by_kind.get(ce["kind"], 0) + 1
@@ -234,6 +333,10 @@ def check_invariants(events: List[Dict[str, Any]],
                    "requeued": sum(len(v) for v in requeued.values())},
         "faults": {"injected": len(chaos_events), "by_kind": by_kind},
         "recoveries": recoveries,
+        "health": {"engine_ran": health_engine_ran,
+                   "raised": len(health_raised),
+                   "by_check": health_by_check,
+                   "stall_flags": stall_flags},
     }
 
 

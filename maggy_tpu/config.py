@@ -44,6 +44,19 @@ class LagomConfig:
     #: to a plan JSON. None (default) = every chaos hook is a no-op. Also
     #: armable without touching code via MAGGY_TPU_CHAOS=<plan.json>.
     chaos: Any = None
+    #: Live health engine (maggy_tpu.telemetry.health): periodic
+    #: straggler/hang/RTT-degradation analysis over spans + runner stats,
+    #: journaled as ``health`` events and surfaced via TELEM /
+    #: ``monitor --health``. Requires telemetry; off when telemetry is.
+    health: bool = True
+    #: Seconds between health checks; None -> max(0.25, hb_interval).
+    health_interval_s: Optional[float] = None
+    #: Hang watchdog: a partition holding a trial with no journal progress
+    #: for ``health_hang_factor * hb_interval`` seconds is flagged (with a
+    #: faulthandler thread dump journaled). Deliberately below the
+    #: heartbeat-loss shape so sub-loss stalls — which the loss scan can
+    #: never see — still surface.
+    health_hang_factor: float = 25.0
 
     def resolved_hb_loss_timeout(self) -> float:
         """Seconds of heartbeat silence before a runner/worker is

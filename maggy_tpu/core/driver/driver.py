@@ -109,6 +109,23 @@ class Driver(ABC):
             arm(self.chaos)
             self.telemetry.event("chaos_armed", seed=plan.seed,
                                  specs=len(plan.specs))
+        # Live health engine: periodic straggler/hang/RTT analysis over
+        # spans + runner stats, on its own daemon thread (buffer-only
+        # record paths, like the journal flusher). Feeds on telemetry, so
+        # it follows telemetry's enablement.
+        self.health = None
+        if self.telemetry.enabled and getattr(config, "health", True):
+            from maggy_tpu.telemetry.health import (DEFAULT_HANG_FACTOR,
+                                                    HealthEngine)
+
+            self.health = HealthEngine(
+                self.telemetry, hb_interval=self.hb_interval,
+                interval_s=getattr(config, "health_interval_s", None),
+                hang_factor=getattr(config, "health_hang_factor",
+                                    DEFAULT_HANG_FACTOR))
+            self.health.attach(reservations=self.server.reservations)
+            self.telemetry.health = self.health
+            self.health.start()
         self._register_msg_callbacks()
 
     # ------------------------------------------------------------- template
@@ -229,6 +246,8 @@ class Driver(ABC):
         self.experiment_done = True
         if self._worker_thread is not None:
             self._worker_thread.join(timeout=5)
+        if self.health is not None:
+            self.health.close()
         self.server.stop()
         if self.chaos is not None:
             # Journal the injection tally, then disarm (only if WE are the

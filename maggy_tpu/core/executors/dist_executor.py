@@ -61,6 +61,15 @@ class DistExecutor:
         reporter.reset(trial_id="dist")
         client = Client(self.server_addr, partition_id, task_attempt,
                         self.hb_interval, self.secret)
+        # Worker-side telemetry, same channel as trial runners: broadcast
+        # cadence + heartbeat RTT + memory, delta-encoded onto heartbeats.
+        # The whole job is one "trial" from the stats' point of view.
+        from maggy_tpu.telemetry.runnerstats import RunnerStats
+
+        stats = RunnerStats()
+        stats.trial_start("dist")
+        reporter.stats = stats
+        client.runner_stats = stats
         try:
             # Advertise our coordinator endpoint; worker 0's is the rendezvous
             # address (reference `rpc.py:409-416`).

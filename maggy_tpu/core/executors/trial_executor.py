@@ -113,6 +113,15 @@ class TrialExecutor:
         )
         client = Client(self.server_addr, partition_id, task_attempt,
                         self.hb_interval, self.secret)
+        # Runner-side telemetry: broadcast cadence + time-to-first-metric
+        # feed in from the reporter, heartbeat RTT from the client, and
+        # the client piggybacks the delta-encoded buffer on its METRIC
+        # heartbeats (no new socket; driver merges it into the journal).
+        from maggy_tpu.telemetry.runnerstats import RunnerStats
+
+        stats = RunnerStats()
+        reporter.stats = stats
+        client.runner_stats = stats
         try:
             capacity = os.environ.get("MAGGY_TPU_CAPACITY")
             client.register(capacity=int(capacity) if capacity else None)
@@ -148,6 +157,7 @@ class TrialExecutor:
                 # trial sends attributable to its span timeline.
                 reporter.reset(trial_id=trial_id,
                                span=client.last_info.get("span"))
+                stats.trial_start(trial_id)
                 try:
                     # Per-trial TensorBoard logdir + hparams record
                     # (reference `trial_executor.py:122-133`).
@@ -197,6 +207,7 @@ class TrialExecutor:
                         )
                         reporter.reset()
                 finally:
+                    stats.trial_end(trial_id)
                     if ctx is not None:
                         ctx.close()
         finally:
@@ -229,6 +240,18 @@ class TrialExecutor:
             if not self.profile:
                 return self.train_fn(**call_params)
             if not _PROFILE_LOCK.acquire(blocking=False):
+                # Another thread-pool trial holds the process-global
+                # profiler: this trial runs UNTRACED. Report it through
+                # the runner-stats channel so the journal carries a
+                # profile_skipped trial event — a missing TensorBoard
+                # trace must be explainable, not a mystery.
+                stats = getattr(reporter, "stats", None) if reporter else None
+                if stats is not None:
+                    stats.note_profile_skipped(
+                        getattr(reporter, "trial_id", None))
+                if reporter is not None:
+                    reporter.log("profiler busy (thread-pool contention); "
+                                 "trial runs untraced")
                 return self.train_fn(**call_params)
             try:
                 import jax

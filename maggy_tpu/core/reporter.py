@@ -28,6 +28,10 @@ class Reporter:
         # the TRIAL reply and is echoed on METRIC/FINAL so driver-side
         # span timelines attribute every hop without guessing.
         self.span: Optional[str] = None
+        # Runner-side stat buffer (telemetry.runnerstats.RunnerStats),
+        # attached by the executor: broadcast() feeds it the step cadence
+        # and time-to-first-metric signals. None = no-op.
+        self.stats = None
         self._stop_flag = False
         self._log_buffer: List[str] = []
         self._log_file = log_file
@@ -91,6 +95,12 @@ class Reporter:
             self.metric = float(metric) \
                 if isinstance(metric, (int, np.number)) else metric
             self.step = int(step)
+            stats = self.stats
+            if stats is not None:
+                # Pure arithmetic (runnerstats.RunnerStats.on_broadcast):
+                # cadence + time-to-first-metric, recorded BEFORE the stop
+                # check so the early-stopped step still counts.
+                stats.on_broadcast(self.step)
             if self._stop_flag:
                 raise exceptions.EarlyStopException(self._materialize(self.metric))
 

@@ -472,15 +472,21 @@ class Server:
                 resp = {"type": "ERR", "error": "unknown message type"}
             else:
                 t0 = time.monotonic()
-                resp = handler(msg)
-                telem = self.telemetry
-                if telem is not None:
-                    # Per-verb server-side service time. Buffer-only
-                    # recording (telemetry journals never write on this
-                    # thread), so the event loop stays I/O-free.
-                    telem.observe_ms(
-                        "rpc.handle_ms.{}".format(msg.get("type")),
-                        (time.monotonic() - t0) * 1e3)
+                try:
+                    resp = handler(msg)
+                finally:
+                    telem = self.telemetry
+                    if telem is not None:
+                        # Per-verb server-side service time, recorded even
+                        # when the handler raised — every registered verb
+                        # MUST show up as an rpc.handle_ms.<verb> histogram
+                        # after one dispatch (the conformance test pins
+                        # it). Buffer-only recording (telemetry journals
+                        # never write on this thread), so the event loop
+                        # stays I/O-free.
+                        telem.observe_ms(
+                            "rpc.handle_ms.{}".format(msg.get("type")),
+                            (time.monotonic() - t0) * 1e3)
         except (ConnectionError, socket.timeout, OSError):
             self._drop(conn)
             return
@@ -634,6 +640,14 @@ class OptimizationServer(Server):
 
     def _metric(self, msg):
         self.reservations.touch(msg["partition_id"])
+        telem = self.telemetry
+        rstats = msg.pop("rstats", None)
+        if rstats and telem is not None:
+            # Runner-side stats piggybacked on the heartbeat (bounded,
+            # delta-encoded): merge + journal with partition attribution.
+            # Popped first so the driver worker's METRIC callback sees the
+            # same payload shape it always did.
+            telem.record_runner_stats(msg["partition_id"], rstats)
         self.driver.enqueue(dict(msg))
         trial_id = msg.get("trial_id")
         stop = False
@@ -744,6 +758,10 @@ class DistributedServer(Server):
 
     def _metric(self, msg):
         self.reservations.touch(msg["partition_id"])
+        telem = self.telemetry
+        rstats = msg.pop("rstats", None)
+        if rstats and telem is not None:
+            telem.record_runner_stats(msg["partition_id"], rstats)
         if self.driver is not None:
             self.driver.enqueue(dict(msg))
         return {"type": "OK"}
@@ -825,6 +843,11 @@ class Client:
         self.secret = secret.encode() if isinstance(secret, str) else secret
         self.done = False
         self.last_info: dict = {}
+        # Runner-side stat buffer (telemetry.runnerstats.RunnerStats),
+        # attached by the executor. When set, the heartbeat loop measures
+        # its round-trip time into it and piggybacks the delta-encoded
+        # stats on the METRIC payload ("rstats" field) — no new socket.
+        self.runner_stats = None
         self._sock = self._connect()
         self._hb_sock = self._connect()
         self._hb_thread: Optional[threading.Thread] = None
@@ -928,23 +951,39 @@ class Client:
                         pass
                     data = {"metric": None, "step": None, "logs": []}
                 sent_tid = data.get("trial_id", reporter.trial_id)
+                payload = {"type": "METRIC", "trial_id": sent_tid,
+                           "value": data["metric"], "step": data["step"],
+                           "logs": data["logs"],
+                           # The span the (metric, step) pair belongs to —
+                           # same rollover rule as sent_tid.
+                           "span": data.get("span")}
+                stats = self.runner_stats
+                delta = None
+                if stats is not None:
+                    delta = stats.snapshot_delta()
+                    if delta:
+                        payload["rstats"] = delta
+                t_send = time.monotonic()
                 try:
-                    resp = self._request(
-                        {"type": "METRIC", "trial_id": sent_tid,
-                         "value": data["metric"], "step": data["step"],
-                         "logs": data["logs"],
-                         # The span the (metric, step) pair belongs to —
-                         # same rollover rule as sent_tid.
-                         "span": data.get("span")},
-                        sock=self._hb_sock, lock=False,
-                    )
+                    resp = self._request(payload, sock=self._hb_sock,
+                                         lock=False)
+                    if stats is not None:
+                        # Retries/backoff included ON PURPOSE: this is the
+                        # control-plane latency the runner experiences, the
+                        # signal the health engine's RTT-degradation check
+                        # feeds on.
+                        stats.observe_hb_rtt(
+                            (time.monotonic() - t_send) * 1e3)
                     if resp.get("type") == "STOP":
                         # Only stop the trial the beat was ABOUT: the
                         # runner may have rolled over to the next trial
                         # while this beat was in flight.
                         reporter.early_stop(trial_id=sent_tid)
                 except ConnectionError:
-                    pass
+                    if stats is not None and delta:
+                        # The ship failed — put the delta back so the next
+                        # beat re-sends it instead of silently losing it.
+                        stats.requeue_delta(delta)
                 self._hb_stop.wait(self.hb_interval)
 
         self._hb_thread = threading.Thread(target=beat, daemon=True, name="heartbeat")

@@ -10,9 +10,14 @@ driver process.
     python -m maggy_tpu.monitor --ticket /shared/exp_dir/runner_ticket.json
     python -m maggy_tpu.monitor --driver 10.0.0.2:41234 --secret-file s.txt --once
     python -m maggy_tpu.monitor --ticket .../runner_ticket.json --telem
+    python -m maggy_tpu.monitor --ticket .../runner_ticket.json --health
 
 ``--telem`` polls the TELEM verb instead: the driver's live telemetry
 snapshot (trial-span scheduling numbers + RPC service-time histograms).
+``--health`` renders the live health view over the same verb: the health
+engine's straggler/hang/RTT flags plus per-partition runner stats (step
+cadence, time-to-first-metric, heartbeat RTT, RSS) — see
+docs/telemetry.md.
 """
 
 from __future__ import annotations
@@ -106,6 +111,69 @@ def render_telem(snap: Dict[str, Any]) -> str:
         lines.append("rpc {}: n={} p50 {} ms p95 {} ms".format(
             name[len("rpc.handle_ms."):], h.get("count"),
             h.get("p50"), h.get("p95")))
+    health = snap.get("health") or {}
+    if health.get("flags"):
+        # One summary line; the full view lives under --health.
+        lines.append("health: {} active flag(s) — run with --health for "
+                     "detail".format(len(health["flags"])))
+    torn = (snap.get("journal") or {}).get("torn_lines") or 0
+    if torn:
+        lines.append("WARNING: journal has {} torn/corrupt line(s) "
+                     "(events were lost)".format(torn))
+    return "\n".join(lines)
+
+
+def _fmt_flag(flag: Dict[str, Any]) -> str:
+    check = flag.get("check")
+    pid = flag.get("partition")
+    if check == "hang":
+        return ("  [hang] partition {}: trial {} silent {}s "
+                "({} bound {}s; thread dump journaled)".format(
+                    pid, flag.get("trial"), flag.get("silent_s"),
+                    flag.get("window", "steady"), flag.get("bound_s")))
+    if check == "straggler":
+        return ("  [straggler] partition {}: {} {} ms vs fleet median {} ms"
+                " (score {})".format(
+                    pid, flag.get("metric"), flag.get("value_ms"),
+                    flag.get("fleet_median_ms"), flag.get("score")))
+    if check == "hb_rtt":
+        return ("  [hb_rtt] partition {}: heartbeat RTT {} ms vs fleet "
+                "median {} ms".format(pid, flag.get("value_ms"),
+                                      flag.get("fleet_median_ms")))
+    return "  [{}] partition {}: {}".format(
+        check, pid, {k: v for k, v in flag.items()
+                     if k not in ("check", "partition")})
+
+
+def render_health(snap: Dict[str, Any]) -> str:
+    """Multi-line view of the TELEM snapshot's health section: active
+    straggler/hang/RTT flags plus a per-partition runner-stats table."""
+    if snap.get("type") == "ERR":
+        return "telemetry: {}".format(snap.get("error"))
+    if not snap.get("enabled", True):
+        return "telemetry: disabled for this experiment"
+    health = snap.get("health")
+    if health is None:
+        return "health: engine not running (health=False or pre-health " \
+               "driver)"
+    flags = health.get("flags") or []
+    lines = ["health: {} active flag(s), {} raised total, {} checks "
+             "run".format(len(flags), health.get("raised_total", 0),
+                          health.get("checks_run", 0))]
+    for flag in flags:
+        lines.append(_fmt_flag(flag))
+    runners = snap.get("runners") or {}
+    for pid in sorted(runners, key=int):
+        s = runners[pid]
+        lines.append(
+            "  runner {}: trial={} steps={} cadence={} ms ttfm={} ms "
+            "hb_rtt={} ms rss={} MB".format(
+                pid, s.get("trial"), s.get("steps"), s.get("cadence_ms"),
+                s.get("ttfm_ms"), s.get("hb_rtt_ms"), s.get("rss_mb")))
+    torn = (snap.get("journal") or {}).get("torn_lines") or 0
+    if torn:
+        lines.append("WARNING: journal has {} torn/corrupt line(s) "
+                     "(events were lost)".format(torn))
     return "\n".join(lines)
 
 
@@ -129,10 +197,16 @@ def main(argv=None) -> int:
                         "reaction) and RPC service-time histograms "
                         "(mutually exclusive with --logs, which streams "
                         "over the LOG verb)")
+    p.add_argument("--health", action="store_true",
+                   help="poll the TELEM verb and render the live health "
+                        "view: straggler/hang/RTT flags from the driver's "
+                        "health engine plus per-partition runner stats "
+                        "(step cadence, time-to-first-metric, heartbeat "
+                        "RTT, RSS)")
     args = p.parse_args(argv)
-    if args.telem and args.logs:
-        p.error("--logs streams over the LOG verb; run it without --telem "
-                "(or use two monitor processes)")
+    if (args.telem or args.health) and args.logs:
+        p.error("--logs streams over the LOG verb; run it without "
+                "--telem/--health (or use two monitor processes)")
 
     if args.ticket:
         from maggy_tpu.runner import read_ticket
@@ -158,8 +232,8 @@ def main(argv=None) -> int:
     logs_seen = 0
     while True:
         try:
-            snap = (poll_telemetry if args.telem else poll_progress)(
-                addr, secret)
+            snap = (poll_telemetry if (args.telem or args.health)
+                    else poll_progress)(addr, secret)
         except (ConnectionError, socket.timeout, OSError) as e:
             if not polled_ok:
                 print("cannot reach driver at {}:{}: {}".format(
@@ -175,7 +249,11 @@ def main(argv=None) -> int:
             continue
         consecutive_failures = 0
         polled_ok = True
-        print(render_telem(snap) if args.telem else render(snap), flush=True)
+        if args.health:
+            print(render_health(snap), flush=True)
+        else:
+            print(render_telem(snap) if args.telem else render(snap),
+                  flush=True)
         if args.logs:
             total = snap.get("log_total", 0)
             tail = snap.get("log_tail", [])

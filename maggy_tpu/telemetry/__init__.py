@@ -68,6 +68,15 @@ class Telemetry:
         # nothing about chaos semantics — it just forwards journaled
         # trial-phase occurrences.
         self.chaos_hook = None
+        # Live health engine (telemetry.health.HealthEngine), attached by
+        # the driver; None = no health section in the snapshot.
+        self.health = None
+        # Runner-side stats (runnerstats.RunnerStats deltas shipped on
+        # heartbeat METRIC payloads), merged per partition, plus the
+        # per-partition trial-progress stamps the hang watchdog reads.
+        self._runner_lock = threading.Lock()
+        self._runner_state: Dict[int, Dict[str, Any]] = {}
+        self._progress: Dict[int, float] = {}
 
     # ------------------------------------------------------------ recording
 
@@ -88,6 +97,8 @@ class Telemetry:
         self._record({"t": t, "ev": "trial", "trial": trial_id,
                       "span": span_id, "phase": phase, **fields})
         self.metrics.counter("trial.phase.{}".format(phase)).inc()
+        if fields.get("partition") is not None:
+            self._note_progress(int(fields["partition"]))
         hook = self.chaos_hook
         if hook is not None:
             try:
@@ -108,6 +119,55 @@ class Telemetry:
         else:
             with self._local_lock:
                 self._local_events.append(event)
+
+    def record_runner_stats(self, partition, stats: Dict[str, Any]) -> None:
+        """Merge one runner's shipped stats delta (the ``rstats`` field a
+        heartbeat METRIC piggybacked): update the live per-partition state
+        + registry gauges, journal the delta with partition attribution,
+        and journal a ``profile_skipped`` trial event for any trial the
+        runner reported running untraced. Buffer-only, like every record
+        path — this runs on the RPC event loop."""
+        if not self.enabled or partition is None or not stats:
+            return
+        pid = int(partition)
+        stats = dict(stats)
+        skipped = stats.pop("profile_skipped", None) or []
+        if stats:
+            with self._runner_lock:
+                merged = self._runner_state.setdefault(pid, {})
+                merged.update(stats)
+                merged["updated_t"] = time.time()
+            for key in ("hb_rtt_ms", "rss_mb", "dev_mem_mb", "cadence_ms",
+                        "ttfm_ms"):
+                if stats.get(key) is not None:
+                    self.metrics.gauge(
+                        "runner.{}.p{}".format(key, pid)).set(stats[key])
+            # Liveness-only updates (RTT/RSS keep changing on a wedged
+            # runner whose heartbeat thread survives) must NOT reset the
+            # hang watchdog — only evidence of trial progress does.
+            from maggy_tpu.telemetry.runnerstats import PROGRESS_KEYS
+
+            if any(k in stats for k in PROGRESS_KEYS):
+                self._note_progress(pid)
+            self._record({"t": time.time(), "ev": "runner_stats",
+                          "partition": pid, **stats})
+        for trial_id in skipped:
+            self.trial_event(trial_id, "profile_skipped", partition=pid)
+
+    def _note_progress(self, pid: int) -> None:
+        with self._runner_lock:
+            self._progress[pid] = time.monotonic()
+
+    def last_progress(self, partition) -> Optional[float]:
+        """Monotonic timestamp of the partition's last trial progress
+        (phase event or runner-reported step movement), or None."""
+        with self._runner_lock:
+            return self._progress.get(int(partition))
+
+    def runner_state(self) -> Dict[int, Dict[str, Any]]:
+        """Per-partition merged runner stats (copies)."""
+        with self._runner_lock:
+            return {pid: dict(s) for pid, s in self._runner_state.items()}
 
     def observe_ms(self, name: str, ms: float) -> None:
         if self.enabled:
@@ -144,10 +204,16 @@ class Telemetry:
         This is the TELEM RPC reply body."""
         if not self.enabled:
             return {"enabled": False}
-        return {"enabled": True,
+        snap = {"enabled": True,
                 "metrics": self.metrics.snapshot(),
                 "spans": self._derived_spans(max_age_s=0.0 if fresh else 1.0),
-                "num_spans": len(self.spans)}
+                "num_spans": len(self.spans),
+                "runners": self.runner_state(),
+                "journal": {"torn_lines": self.journal.torn_lines
+                            if self.journal is not None else 0}}
+        if self.health is not None:
+            snap["health"] = self.health.snapshot()
+        return snap
 
     # ------------------------------------------------------------ lifecycle
 
@@ -163,8 +229,14 @@ class Telemetry:
 def replay_journal(path: str, env=None) -> Dict[str, Any]:
     """Offline replay: journal file -> derived scheduling metrics. Pure —
     the same journal always reproduces the same numbers (bench.py's
-    hand-off / early-stop detail block is exactly this call)."""
-    return derive(read_events(path, env=env))
+    hand-off / early-stop detail block is exactly this call). The output
+    additionally carries ``torn_lines``: corrupt journal lines the reader
+    skipped, so corruption is visible instead of quietly shrinking the
+    dataset."""
+    events = read_events(path, env=env)
+    out = derive(events)
+    out["torn_lines"] = getattr(events, "torn_lines", 0)
+    return out
 
 
 __all__ = [

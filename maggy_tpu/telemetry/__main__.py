@@ -1,0 +1,68 @@
+"""Telemetry CLI: ``python -m maggy_tpu.telemetry <command>``.
+
+    trace <exp_dir|journal.jsonl> [-o OUT]   journal -> Perfetto JSON
+    replay <exp_dir|journal.jsonl>           journal -> derived numbers
+
+``trace`` writes Chrome-trace-event JSON loadable in https://ui.perfetto.dev
+or chrome://tracing (one track per partition, trial slices with phase
+sub-slices, instant markers for stops/requeues/chaos/health — see
+docs/telemetry.md for a walkthrough of reading a hand-off gap). ``replay``
+prints the same derived scheduling numbers the driver/TELEM verb computes,
+plus the journal's ``torn_lines`` count so corruption is visible.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from maggy_tpu.telemetry import JOURNAL_NAME, read_events, replay_journal
+from maggy_tpu.telemetry.trace import write_trace
+
+
+def _resolve_journal(path: str) -> str:
+    """Accept an experiment dir or a journal file path."""
+    if os.path.isdir(path):
+        path = os.path.join(path, JOURNAL_NAME)
+    if not os.path.exists(path):
+        raise FileNotFoundError("no telemetry journal at {}".format(path))
+    return path
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="maggy_tpu.telemetry",
+        description="Offline telemetry tools over a journal artifact.")
+    sub = p.add_subparsers(dest="command", required=True)
+    pt = sub.add_parser("trace",
+                        help="export a Perfetto/Chrome-trace timeline")
+    pt.add_argument("path", help="experiment dir or telemetry.jsonl path")
+    pt.add_argument("-o", "--out",
+                    help="output file (default: <exp_dir>/trace.json)")
+    pr = sub.add_parser("replay", help="print journal-derived scheduling "
+                                       "numbers as JSON")
+    pr.add_argument("path", help="experiment dir or telemetry.jsonl path")
+    args = p.parse_args(argv)
+
+    journal = _resolve_journal(args.path)
+    if args.command == "replay":
+        print(json.dumps(replay_journal(journal), indent=2, default=str))
+        return 0
+
+    events = read_events(journal)
+    out = args.out or os.path.join(os.path.dirname(journal), "trace.json")
+    n = write_trace(events, out)
+    torn = getattr(events, "torn_lines", 0)
+    msg = ("trace: {} journal events -> {} trace events -> {}"
+           .format(len(events), n, out))
+    if torn:
+        msg += " ({} torn line(s) skipped)".format(torn)
+    print(msg)
+    print("open in https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,10 @@ class TelemetryJournal:
         self._append_ok: Optional[bool] = None
         self._dirty = False
         self._closed = False
+        #: Corrupt/torn lines skipped when loading a previous run's journal
+        #: (load_existing). Exposed in the TELEM snapshot so journal
+        #: corruption is visible instead of quietly shrinking the dataset.
+        self.torn_lines = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._flusher, daemon=True, name=FLUSHER_THREAD_NAME)
@@ -87,6 +91,7 @@ class TelemetryJournal:
         except Exception:  # noqa: BLE001 - a torn journal must not block resume
             return 0
         with self._lock:
+            self.torn_lines += restored.torn_lines
             self._events = restored + self._events
             # _flushed deliberately stays 0: the next flush takes the
             # full-rewrite path, which re-persists the restored prefix AND
@@ -153,8 +158,18 @@ class TelemetryJournal:
         self.flush()
 
 
-def _parse_jsonl(text: str) -> List[Dict[str, Any]]:
-    events = []
+class JournalEvents(list):
+    """Parsed journal events, plus ``torn_lines``: how many corrupt lines
+    the parser had to skip. A torn tail line from a hard kill is expected
+    (at most 1); more than that means real corruption silently shrinking
+    the dataset — callers surface the count instead of hiding it."""
+
+    torn_lines: int = 0
+
+
+def _parse_jsonl(text: str) -> JournalEvents:
+    events = JournalEvents()
+    torn = 0
     for line in text.splitlines():
         line = line.strip()
         if not line:
@@ -162,15 +177,20 @@ def _parse_jsonl(text: str) -> List[Dict[str, Any]]:
         try:
             ev = json.loads(line)
         except ValueError:
-            continue  # torn tail line from a hard kill mid-flush
+            torn += 1  # torn tail line from a hard kill mid-flush
+            continue
         if isinstance(ev, dict):
             events.append(ev)
+        else:
+            torn += 1  # valid JSON but not an event object
+    events.torn_lines = torn
     return events
 
 
-def read_events(path: str, env=None) -> List[Dict[str, Any]]:
+def read_events(path: str, env=None) -> JournalEvents:
     """Load a journal's events: through ``env`` when given, else the local
-    filesystem (offline replay of a copied artifact)."""
+    filesystem (offline replay of a copied artifact). The returned list
+    carries ``torn_lines`` — the count of corrupt/torn lines skipped."""
     if env is not None:
         return _parse_jsonl(env.load(path))
     with open(path) as f:

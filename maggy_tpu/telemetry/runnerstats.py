@@ -1,0 +1,234 @@
+"""Runner-side telemetry: the worker's half of the observability stack.
+
+The driver's spans (spans.py) see every control-plane hop, but until now
+the runners themselves were blind — no step cadence, compile-stall signal,
+heartbeat round-trip time, or memory attribution ever left the worker.
+``RunnerStats`` is the lightweight buffer each trial executor owns:
+
+- **train_fn start/end** (``trial_start``/``trial_end``) — wall attribution
+  for the time the runner actually spent inside user code;
+- **metric-broadcast cadence** (``on_broadcast``, hooked from
+  ``Reporter.broadcast``) — an EWMA of the inter-broadcast gap, the
+  runner-observed step rate the health engine's straggler scoring feeds on;
+- **time-to-first-metric** — trial start to first broadcast, the
+  compile-stall proxy (XLA compiles inside the first step);
+- **heartbeat round-trip time** (``observe_hb_rtt``, measured in
+  ``Client.start_heartbeat``) — control-plane latency as the runner
+  experiences it, retries and backoff included;
+- **process RSS / device memory** — sampled at most every
+  ``mem_interval_s`` via /proc (no psutil) and, when a JAX backend is
+  already initialized in this process, ``device.memory_stats()``.
+
+Shipping is piggybacked on the existing heartbeat METRIC payload
+(``rstats`` field) — no new socket, no new verb. ``snapshot_delta()``
+returns only the fields that changed since the last successful ship
+(delta-encoded, bounded to a handful of scalars), so a steady-state
+runner adds a few bytes per beat. Every record path is in-memory
+arithmetic under one small lock; the only syscalls are the rate-limited
+memory probes on the heartbeat thread.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: EWMA smoothing for cadence / RTT (~last 10 observations dominate).
+_EWMA_ALPHA = 0.2
+
+#: Keys in a shipped delta that evidence TRIAL progress (new broadcasts /
+#: a trial boundary), as opposed to liveness-only fields (hb_rtt_ms, rss)
+#: a wedged-but-beating runner keeps updating. The driver's hang watchdog
+#: counts only these as progress.
+PROGRESS_KEYS = ("trial", "steps", "ttfm_ms", "cadence_ms", "trials_done")
+
+#: Sentinel distinguishing "never shipped" from "shipped as None" in the
+#: delta ledger: trial/ttfm_ms legitimately TRANSITION to None, and a
+#: plain .get(k) would read a requeued (deleted) key as already-None and
+#: silently drop the re-send.
+_NEVER_SHIPPED = object()
+
+
+def _rss_mb() -> Optional[float]:
+    """Resident set size in MB, dependency-free (Linux /proc, getrusage
+    fallback)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") / 1e6
+    except Exception:  # noqa: BLE001 - non-Linux
+        try:
+            import resource
+
+            # ru_maxrss: KB on Linux, bytes on macOS — close enough for a
+            # fallback gauge (the primary path is /proc).
+            ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            return ru / 1024.0 if sys.platform != "darwin" else ru / 1e6
+        except Exception:  # noqa: BLE001
+            return None
+
+
+def _device_mem_mb() -> Optional[float]:
+    """bytes_in_use of the first local device, when a JAX backend already
+    lives in this process. NEVER triggers a jax import or backend init —
+    a heartbeat thread must not pay a multi-second TPU client startup for
+    a gauge (a blocked beat reads as runner death)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        # Peek at the backend registry WITHOUT initializing: local_devices()
+        # on a cold process would bring the whole TPU client up.
+        xla_bridge = sys.modules.get("jax._src.xla_bridge")
+        if xla_bridge is None or not getattr(xla_bridge, "_backends", None):
+            return None
+        devices = jax.local_devices()
+        if not devices:
+            return None
+        stats = devices[0].memory_stats()
+        if stats and stats.get("bytes_in_use") is not None:
+            return round(stats["bytes_in_use"] / 1e6, 1)
+    except Exception:  # noqa: BLE001 - backend without memory_stats
+        return None
+    return None
+
+
+class RunnerStats:
+    """Thread-safe runner-side stat buffer with delta-encoded shipping."""
+
+    def __init__(self, mem_interval_s: float = 2.0):
+        self._lock = threading.Lock()
+        self.mem_interval_s = mem_interval_s
+        self._trial_id: Optional[str] = None
+        self._trial_t0: Optional[float] = None   # monotonic train start
+        self._last_broadcast: Optional[float] = None
+        self._steps = 0              # broadcasts within the current trial
+        self._trials_done = 0
+        self._cadence_ms: Optional[float] = None
+        self._ttfm_ms: Optional[float] = None
+        self._hb_rtt_ms: Optional[float] = None
+        self._rss_mb: Optional[float] = None
+        self._dev_mem_mb: Optional[float] = None
+        self._last_mem_sample = 0.0
+        self._profile_skipped: List[str] = []
+        self._last_shipped: Dict[str, Any] = {}
+
+    # ----------------------------------------------------------- recording
+
+    def trial_start(self, trial_id: str) -> None:
+        """The executor accepted a trial and is about to enter train_fn."""
+        with self._lock:
+            self._trial_id = trial_id
+            self._trial_t0 = time.monotonic()
+            self._last_broadcast = None
+            self._steps = 0
+            self._ttfm_ms = None
+
+    def trial_end(self, trial_id: Optional[str] = None) -> None:
+        with self._lock:
+            if trial_id is not None and trial_id != self._trial_id:
+                return
+            self._trials_done += 1
+            self._trial_id = None
+            self._trial_t0 = None
+
+    def on_broadcast(self, step: Optional[int] = None) -> None:
+        """One reporter.broadcast from the training loop. Pure arithmetic —
+        this rides the user's step cadence."""
+        now = time.monotonic()
+        with self._lock:
+            self._steps += 1
+            if self._ttfm_ms is None and self._trial_t0 is not None:
+                self._ttfm_ms = (now - self._trial_t0) * 1e3
+            if self._last_broadcast is not None:
+                gap_ms = (now - self._last_broadcast) * 1e3
+                self._cadence_ms = gap_ms if self._cadence_ms is None else \
+                    (1 - _EWMA_ALPHA) * self._cadence_ms + _EWMA_ALPHA * gap_ms
+            self._last_broadcast = now
+
+    def observe_hb_rtt(self, rtt_ms: float) -> None:
+        with self._lock:
+            self._hb_rtt_ms = rtt_ms if self._hb_rtt_ms is None else \
+                (1 - _EWMA_ALPHA) * self._hb_rtt_ms + _EWMA_ALPHA * rtt_ms
+
+    def note_profile_skipped(self, trial_id: Optional[str]) -> None:
+        """The profiler lock was contended: this trial runs untraced.
+        Shipped to the driver so the missing TensorBoard trace is
+        explainable from the journal."""
+        if trial_id:
+            with self._lock:
+                self._profile_skipped.append(trial_id)
+
+    # ------------------------------------------------------------ shipping
+
+    def _maybe_sample_memory(self) -> None:
+        """Rate-limited memory probes, performed OUTSIDE the lock: the
+        /proc read and device.memory_stats() can block, and broadcast()
+        on the training hot path takes the same lock — the probe must
+        never inject stalls into the cadence it measures."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_mem_sample < self.mem_interval_s:
+                return
+            self._last_mem_sample = now
+        rss = _rss_mb()
+        dev = _device_mem_mb()
+        with self._lock:
+            if rss is not None:
+                self._rss_mb = round(rss, 1)
+            if dev is not None:
+                self._dev_mem_mb = dev
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Full current stat dict (rounded). ``trial`` and ``ttfm_ms`` are
+        kept even when None — they legitimately TRANSITION to None at a
+        trial boundary, and the delta encoding must be able to ship that
+        transition (or the driver's merged state would claim a finished
+        trial forever). The remaining fields only ever go None -> value,
+        so their Nones are omitted as start-up noise."""
+        self._maybe_sample_memory()
+        with self._lock:
+            snap: Dict[str, Any] = {
+                "trial": self._trial_id,
+                "steps": self._steps,
+                "trials_done": self._trials_done,
+                "ttfm_ms": None if self._ttfm_ms is None
+                else round(self._ttfm_ms, 1),
+                "cadence_ms": None if self._cadence_ms is None
+                else round(self._cadence_ms, 1),
+                "hb_rtt_ms": None if self._hb_rtt_ms is None
+                else round(self._hb_rtt_ms, 2),
+                "rss_mb": self._rss_mb,
+                "dev_mem_mb": self._dev_mem_mb,
+            }
+        return {k: v for k, v in snap.items()
+                if v is not None or k in ("trial", "ttfm_ms")}
+
+    def snapshot_delta(self) -> Dict[str, Any]:
+        """Fields changed since the last ship, plus any pending
+        profile_skipped trial ids (drained). Empty dict = nothing to ship
+        (the caller omits the ``rstats`` payload field entirely)."""
+        current = self.snapshot()
+        with self._lock:
+            delta = {k: v for k, v in current.items()
+                     if self._last_shipped.get(k, _NEVER_SHIPPED) != v}
+            self._last_shipped.update(delta)
+            if self._profile_skipped:
+                delta["profile_skipped"] = self._profile_skipped
+                self._profile_skipped = []
+        return delta
+
+    def requeue_delta(self, delta: Dict[str, Any]) -> None:
+        """A ship failed (heartbeat ConnectionError): put the delta back so
+        the next beat re-sends it instead of silently losing the fields."""
+        if not delta:
+            return
+        with self._lock:
+            skipped = delta.get("profile_skipped") or []
+            self._profile_skipped = list(skipped) + self._profile_skipped
+            for k, v in delta.items():
+                if k != "profile_skipped" and self._last_shipped.get(k) == v:
+                    del self._last_shipped[k]

@@ -28,8 +28,11 @@ from typing import Any, Dict, List, Optional
 #: ``requeued`` marks a trial re-entering the schedule after runner loss /
 #: blacklist — the explicit edge recovery latency derives from (the span's
 #: first-occurrence timestamps alone cannot carry it).
+#: ``profile_skipped`` is an annotation, not a lifecycle edge: the runner
+#: reported the trial ran untraced (profiler lock contended).
 PHASES = ("queued", "assigned", "running", "first_metric",
-          "stop_flagged", "stop_sent", "finalized", "lost", "requeued")
+          "stop_flagged", "stop_sent", "finalized", "lost", "requeued",
+          "profile_skipped")
 
 #: Gaps at or above this bound are scheduling (a runner idling on purpose at
 #: a rung barrier), not hand-off overhead — excluded from the gap stats.

@@ -1,0 +1,217 @@
+"""Timeline export: telemetry journal -> Chrome-trace-event / Perfetto JSON.
+
+``python -m maggy_tpu.telemetry trace <exp_dir>`` converts any telemetry
+journal into the JSON object format chrome://tracing and https://ui.perfetto.dev
+load natively — so the paper's scheduling claim is literally *visible*:
+one track per partition, each trial a slice, and the hand-off gap between
+one trial's ``finalized`` and the same runner's next ``running`` an actual
+visible gap between slices.
+
+Mapping:
+
+- **tracks**: one trace "process" per partition (``pid = partition + 1``,
+  named via process_name metadata) plus a ``driver`` track (``pid = 0``)
+  for events with no partition attribution (queued, stop_flagged,
+  experiment lifecycle).
+- **trial slices**: per run attempt (a requeued trial re-runs as a new
+  slice on its new partition), an outer ``X`` slice from ``assigned`` to
+  the attempt's terminal event, with nested phase sub-slices:
+  ``dispatch`` (assigned → running), ``startup`` (running → first_metric;
+  the compile stall made visible), ``train`` (first_metric → finalized).
+- **instant events**: STOP flags (``stop_flagged`` / ``stop_sent``),
+  ``requeued`` / ``lost`` edges, chaos injections (``chaos:<kind>``), and
+  health findings (``health:<check>``).
+- **counters**: runner-stats memory/RTT samples become ``C`` counter
+  events per partition (``rss_mb``, ``hb_rtt_ms``), so a leaking trial is
+  a visibly climbing line under its track.
+
+The exporter is pure (events in, dict out) and the journal is the only
+input — any soak/bench artifact can be rendered after the fact.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+#: pid of the driver track; partition p maps to pid p + 1.
+DRIVER_PID = 0
+
+#: Phase pairs rendered as nested sub-slices inside a trial slice.
+_SUB_SLICES = (
+    ("dispatch", "assigned", "running"),
+    ("startup", "running", "first_metric"),
+    ("train", "first_metric", "finalized"),
+)
+
+#: Trial phases rendered as instant markers rather than slice edges.
+_INSTANT_PHASES = ("queued", "stop_flagged", "stop_sent", "requeued",
+                   "lost", "profile_skipped")
+
+
+def _pid(partition: Optional[int]) -> int:
+    return DRIVER_PID if partition is None else int(partition) + 1
+
+
+def build_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Pure journal-events -> Chrome-trace dict (``{"traceEvents": [...]}``,
+    timestamps in microseconds relative to the first event)."""
+    times = [e["t"] for e in events if isinstance(e.get("t"), (int, float))]
+    t0 = min(times) if times else 0.0
+
+    def us(t: float) -> int:
+        return int(round((t - t0) * 1e6))
+
+    out: List[Dict[str, Any]] = []
+    partitions = set()
+    by_trial: Dict[str, List[Dict[str, Any]]] = {}
+    for ev in events:
+        kind = ev.get("ev")
+        t = ev.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        pid = ev.get("partition")
+        if pid is not None:
+            partitions.add(int(pid))
+        if kind == "trial" and ev.get("trial") is not None:
+            by_trial.setdefault(ev["trial"], []).append(ev)
+        elif kind == "chaos":
+            out.append({"name": "chaos:{}".format(ev.get("kind")),
+                        "cat": "chaos", "ph": "i", "s": "t",
+                        "ts": us(t), "pid": _pid(pid), "tid": 0,
+                        "args": {k: v for k, v in ev.items()
+                                 if k not in ("ev", "t")}})
+        elif kind == "health":
+            out.append({"name": "health:{}".format(ev.get("check")),
+                        "cat": "health", "ph": "i", "s": "t",
+                        "ts": us(t), "pid": _pid(pid), "tid": 0,
+                        "args": {k: v for k, v in ev.items()
+                                 if k not in ("ev", "t", "stacks")}})
+        elif kind == "runner_stats" and pid is not None:
+            for counter in ("rss_mb", "hb_rtt_ms"):
+                if ev.get(counter) is not None:
+                    out.append({"name": counter, "cat": "runner",
+                                "ph": "C", "ts": us(t), "pid": _pid(pid),
+                                "args": {counter: ev[counter]}})
+        elif kind in ("experiment", "runner", "worker", "chaos_armed",
+                      "chaos_summary"):
+            out.append({"name": "{}:{}".format(kind, ev.get("phase", "")),
+                        "cat": "lifecycle", "ph": "i", "s": "p",
+                        "ts": us(t), "pid": _pid(pid), "tid": 0,
+                        "args": {k: v for k, v in ev.items()
+                                 if k not in ("ev", "t")}})
+
+    for trial_id, evs in by_trial.items():
+        evs.sort(key=lambda e: e["t"])
+        out.extend(_trial_slices(trial_id, evs, us))
+        for ev in evs:
+            if ev.get("phase") in _INSTANT_PHASES:
+                out.append({"name": "{}:{}".format(ev["phase"],
+                                                   trial_id[:8]),
+                            "cat": "trial", "ph": "i", "s": "t",
+                            "ts": us(ev["t"]),
+                            "pid": _pid(ev.get("partition")), "tid": 0,
+                            "args": {k: v for k, v in ev.items()
+                                     if k not in ("ev", "t")}})
+
+    # Track naming metadata: driver + one process per partition, sorted so
+    # Perfetto lists partition 0..N in order.
+    meta = [{"name": "process_name", "ph": "M", "pid": DRIVER_PID, "tid": 0,
+             "args": {"name": "driver"}},
+            {"name": "process_sort_index", "ph": "M", "pid": DRIVER_PID,
+             "tid": 0, "args": {"sort_index": -1}}]
+    for p in sorted(partitions):
+        meta.append({"name": "process_name", "ph": "M", "pid": _pid(p),
+                     "tid": 0, "args": {"name": "partition {}".format(p)}})
+        meta.append({"name": "process_sort_index", "ph": "M", "pid": _pid(p),
+                     "tid": 0, "args": {"sort_index": p}})
+    out.sort(key=lambda e: e.get("ts", 0))
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms",
+            "otherData": {"source": "maggy_tpu.telemetry",
+                          "t0_unix_s": t0,
+                          "partitions": sorted(partitions),
+                          "trials": len(by_trial)}}
+
+
+def _trial_slices(trial_id: str, evs: List[Dict[str, Any]], us) -> List[dict]:
+    """Slices for one trial: one outer slice (+ phase sub-slices) per run
+    attempt, split on ``assigned`` occurrences so a requeued trial renders
+    as separate slices on each partition it visited."""
+    out: List[dict] = []
+    attempts: List[List[Dict[str, Any]]] = []
+    for ev in evs:
+        if ev.get("phase") == "assigned" or not attempts:
+            attempts.append([])
+        attempts[-1].append(ev)
+    for attempt in attempts:
+        marks: Dict[str, float] = {}
+        partition = None
+        terminal = None
+        for ev in attempt:
+            phase = ev.get("phase")
+            if phase not in marks:
+                marks[phase] = ev["t"]
+            if ev.get("partition") is not None:
+                partition = int(ev["partition"])
+            if phase in ("finalized", "lost") and terminal is None:
+                terminal = ev["t"]
+        start = marks.get("assigned")
+        if start is None or partition is None:
+            continue
+        end = terminal if terminal is not None else attempt[-1]["t"]
+        if end < start:
+            continue
+        args = {"trial": trial_id}
+        final = next((e for e in attempt if e.get("phase") == "finalized"),
+                     None)
+        if final is not None:
+            args.update({k: final[k] for k in ("early_stop", "error", "span")
+                         if final.get(k) is not None})
+        out.append({"name": "trial {}".format(trial_id[:8]), "cat": "trial",
+                    "ph": "X", "ts": us(start),
+                    "dur": max(1, us(end) - us(start)),
+                    "pid": _pid(partition), "tid": 0, "args": args})
+        for name, p_from, p_to in _SUB_SLICES:
+            a, b = marks.get(p_from), marks.get(p_to)
+            if a is None or b is None or b < a:
+                continue
+            out.append({"name": name, "cat": "phase", "ph": "X",
+                        "ts": us(a), "dur": max(1, us(b) - us(a)),
+                        "pid": _pid(partition), "tid": 0,
+                        "args": {"trial": trial_id}})
+    return out
+
+
+def validate_trace(trace: Dict[str, Any]) -> int:
+    """Sanity-check a trace dict is loadable Chrome-trace JSON: a
+    ``traceEvents`` list whose entries carry the mandatory keys. Returns
+    the event count; raises ValueError otherwise. bench.py runs this on
+    the emitted file before recording its path as an artifact."""
+    events = trace.get("traceEvents") if isinstance(trace, dict) else None
+    if not isinstance(events, list) or not events:
+        raise ValueError("not a Chrome trace: missing/empty traceEvents")
+    if all(ev.get("ph") == "M" for ev in events if isinstance(ev, dict)):
+        raise ValueError("trace carries only metadata — the journal had "
+                         "no renderable events")
+    for ev in events:
+        if not isinstance(ev, dict) or "ph" not in ev or "pid" not in ev:
+            raise ValueError("malformed trace event: {!r}".format(ev))
+        if ev["ph"] in ("X", "i", "C") and "ts" not in ev:
+            raise ValueError("trace event without ts: {!r}".format(ev))
+    json.dumps(trace)  # must be pure-JSON serializable
+    return len(events)
+
+
+def write_trace(events: List[Dict[str, Any]], out_path: str,
+                env=None) -> int:
+    """Build, validate, and write the trace. Returns the trace-event
+    count."""
+    trace = build_trace(events)
+    n = validate_trace(trace)
+    payload = json.dumps(trace)
+    if env is not None:
+        env.dump(payload, out_path)
+    else:
+        with open(out_path, "w") as f:
+            f.write(payload)
+    return n

@@ -35,6 +35,11 @@ def pytest_configure(config):
         "chaos: fault-injection tests (maggy_tpu.chaos). The deterministic "
         "single-process smoke stays in the fast lane; the multi-process "
         "soak is additionally marked slow. Select with -m chaos.")
+    config.addinivalue_line(
+        "markers",
+        "health: live health-engine tests (maggy_tpu.telemetry.health) — "
+        "straggler/hang/RTT detection and the stall->flag chaos "
+        "invariant. Select with -m health.")
 
 
 @pytest.fixture(autouse=True)

@@ -213,3 +213,61 @@ class TestProbeRetry:
             assert not stale.exists(), "stale lockfile not removed"
         finally:
             os.close(fd)
+
+
+class TestTraceArtifact:
+    """bench.py must validate the emitted timeline parses as Chrome-trace
+    JSON before recording its path — a BENCH artifact must never point at
+    an unloadable file."""
+
+    def _journal(self, exp_dir):
+        import json as _json
+
+        from maggy_tpu.telemetry import JOURNAL_NAME
+
+        events = [
+            {"t": 1.0, "ev": "trial", "trial": "a", "phase": "queued"},
+            {"t": 1.1, "ev": "trial", "trial": "a", "phase": "assigned",
+             "partition": 0},
+            {"t": 1.2, "ev": "trial", "trial": "a", "phase": "running",
+             "partition": 0},
+            {"t": 2.0, "ev": "trial", "trial": "a", "phase": "finalized",
+             "partition": 0},
+        ]
+        with open(os.path.join(exp_dir, JOURNAL_NAME), "w") as f:
+            for ev in events:
+                f.write(_json.dumps(ev) + "\n")
+
+    def test_valid_journal_records_path(self, tmp_path):
+        import json as _json
+
+        exp_dir = str(tmp_path)
+        self._journal(exp_dir)
+        path = bench._export_trace_artifact(exp_dir)
+        assert path == os.path.join(exp_dir, "trace.json")
+        with open(path) as f:
+            assert _json.load(f)["traceEvents"]
+
+    def test_missing_journal_records_none(self, tmp_path):
+        assert bench._export_trace_artifact(str(tmp_path)) is None
+
+    def test_unwritable_or_invalid_trace_records_none(self, tmp_path,
+                                                      monkeypatch):
+        exp_dir = str(tmp_path)
+        self._journal(exp_dir)
+        # Simulate a writer that produced garbage: validation must refuse
+        # to record the path.
+        import maggy_tpu.telemetry.trace as trace_mod
+
+        def bad_write(events, out, env=None):
+            with open(out, "w") as f:
+                f.write("NOT JSON")
+            return 1
+
+        monkeypatch.setattr(bench, "log", lambda *a, **k: None)
+        real = trace_mod.write_trace
+        monkeypatch.setattr(trace_mod, "write_trace", bad_write)
+        try:
+            assert bench._export_trace_artifact(exp_dir) is None
+        finally:
+            monkeypatch.setattr(trace_mod, "write_trace", real)

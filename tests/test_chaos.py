@@ -609,3 +609,110 @@ class TestMultiProcessSoak:
         chaos = [e for e in events if e.get("ev") == "chaos"
                  and e.get("kind") == "kill_runner"]
         assert chaos and chaos[0]["mechanism"] == "sigkill"
+
+
+# ------------------------------------------------- health (stall invariant)
+
+
+@pytest.mark.health
+class TestStallFlagInvariant:
+    """Invariant 5: an injected stall must be flagged by the health
+    engine, within bounded time, for the right partition — checked as a
+    pure function over journal events."""
+
+    BASE = [
+        {"t": 0.5, "ev": "health", "check": "engine", "status": "started"},
+        {"t": 1.0, "ev": "trial", "trial": "a", "phase": "queued"},
+        {"t": 2.0, "ev": "chaos", "kind": "stall_runner", "partition": 1,
+         "duration_s": 2.0},
+        {"t": 6.0, "ev": "trial", "trial": "a", "phase": "finalized"},
+        {"t": 7.0, "ev": "experiment", "phase": "end"},
+    ]
+
+    def test_flag_within_bound_passes_and_latency_reported(self):
+        events = self.BASE + [
+            {"t": 3.1, "ev": "health", "check": "hang", "partition": 1,
+             "status": "raised"},
+        ]
+        report = check_invariants(events, stall_flag_bound_s=2.0)
+        assert report["ok"], report["violations"]
+        flag = report["health"]["stall_flags"][0]
+        assert flag["flagged"] and flag["checks"] == ["hang"]
+        assert flag["flag_latency_s"] == pytest.approx(1.1)
+
+    def test_unflagged_stall_is_a_violation(self):
+        report = check_invariants(self.BASE, stall_flag_bound_s=2.0)
+        assert not report["ok"]
+        assert any("unflagged stall" in v for v in report["violations"])
+
+    def test_late_or_wrong_partition_flag_does_not_count(self):
+        late = self.BASE + [
+            {"t": 9.0, "ev": "health", "check": "hang", "partition": 1,
+             "status": "raised"},
+        ]
+        assert not check_invariants(late, stall_flag_bound_s=2.0)["ok"]
+        wrong = self.BASE + [
+            {"t": 2.5, "ev": "health", "check": "hang", "partition": 0,
+             "status": "raised"},
+        ]
+        assert not check_invariants(wrong, stall_flag_bound_s=2.0)["ok"]
+
+    def test_cleared_events_do_not_satisfy_the_invariant(self):
+        events = self.BASE + [
+            {"t": 2.5, "ev": "health", "check": "hang", "partition": 1,
+             "status": "cleared"},
+        ]
+        assert not check_invariants(events, stall_flag_bound_s=2.0)["ok"]
+
+    def test_none_bound_skips_the_invariant(self):
+        # health=False soaks: nothing can flag, the invariant is vacuous.
+        report = check_invariants(self.BASE, stall_flag_bound_s=None)
+        assert report["ok"], report["violations"]
+
+    def test_journal_without_engine_marker_skips_the_invariant(self):
+        """A pre-health (or health=False) journal has nothing watching —
+        a stall it records is a skipped check, not a violation, even
+        under the default bound."""
+        no_marker = [e for e in self.BASE
+                     if e.get("check") != "engine"]
+        report = check_invariants(no_marker)
+        assert report["ok"], report["violations"]
+        assert report["health"]["engine_ran"] is False
+        assert report["health"]["stall_flags"] == []
+
+
+@pytest.mark.health
+@pytest.mark.timeout(180)
+class TestStallSoak:
+    """E2E: a cooperative stall SHORTER than the heartbeat-loss bound —
+    invisible to the loss scan by construction — must still surface as a
+    health flag, asserted through the journal like every chaos
+    invariant."""
+
+    def test_stall_produces_health_flag_within_bound(self, tmp_path):
+        from maggy_tpu.chaos.harness import stall_plan
+
+        report = run_soak(
+            plan=stall_plan(seed=5, duration_s=2.0), seed=5, num_trials=8,
+            workers=3, hb_interval=0.05,
+            # Loss bound ABOVE the stall: the loss scan must stay blind
+            # (no requeue) — only the health engine sees the stall.
+            hb_loss_timeout=10.0,
+            base_dir=str(tmp_path / "stall_soak"),
+            config_overrides={"health_hang_factor": 10.0,
+                              "health_interval_s": 0.1})
+        assert report["ok"], report["violations"]
+        assert report["faults"]["by_kind"] == {"stall_runner": 1}
+        assert report["trials"]["requeued"] == 0  # loss scan stayed blind
+        flag = report["health"]["stall_flags"][0]
+        assert flag["flagged"], report["health"]
+        assert flag["flag_latency_s"] is not None
+        assert set(flag["checks"]) & {"hang", "straggler"}
+
+    def test_fault_free_soak_journals_zero_health_flags(self, tmp_path):
+        report = run_soak(plan=FaultPlan([], seed=3), seed=3, num_trials=8,
+                          workers=3,
+                          base_dir=str(tmp_path / "fault_free"))
+        assert report["ok"], report["violations"]
+        assert report["health"]["raised"] == 0, report["health"]
+        assert report["health"]["stall_flags"] == []

@@ -1,0 +1,474 @@
+"""Live health engine (maggy_tpu.telemetry.health): MAD straggler scoring,
+heartbeat-RTT degradation, the hang watchdog (with journaled thread dump),
+raise/clear dedup, the TELEM/monitor surface, and the runner-stats buffer
+that feeds it (delta encoding, heartbeat piggyback, progress gating)."""
+
+import os
+import time
+
+import pytest
+
+from maggy_tpu import monitor
+from maggy_tpu.core.environment import EnvSing
+from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+from maggy_tpu.telemetry import Telemetry
+from maggy_tpu.telemetry.health import HealthEngine, thread_dump
+from maggy_tpu.telemetry.runnerstats import PROGRESS_KEYS, RunnerStats
+
+pytestmark = pytest.mark.health
+
+
+@pytest.fixture(autouse=True)
+def local_env(tmp_path):
+    env = LocalEnv(base_dir=str(tmp_path / "exp"))
+    EnvSing.set_instance(env)
+    yield env
+    EnvSing.reset()
+
+
+# -------------------------------------------------------------- runner stats
+
+
+class TestRunnerStats:
+    def test_cadence_and_ttfm(self):
+        rs = RunnerStats()
+        rs.trial_start("t1")
+        rs.on_broadcast(0)
+        time.sleep(0.02)
+        rs.on_broadcast(1)
+        snap = rs.snapshot()
+        assert snap["steps"] == 2
+        assert snap["ttfm_ms"] >= 0
+        assert snap["cadence_ms"] >= 15  # ~20 ms gap, EWMA of one sample
+
+    def test_delta_encoding_ships_only_changes(self):
+        rs = RunnerStats()
+        rs.trial_start("t1")
+        rs.on_broadcast(0)
+        first = rs.snapshot_delta()
+        assert first["trial"] == "t1" and first["steps"] == 1
+        # Nothing changed -> empty delta -> the heartbeat omits rstats.
+        assert rs.snapshot_delta() == {}
+        rs.on_broadcast(1)
+        second = rs.snapshot_delta()
+        assert second["steps"] == 2
+        assert "trial" not in second  # unchanged field not re-shipped
+
+    def test_requeue_delta_reships_after_failed_beat(self):
+        rs = RunnerStats()
+        rs.observe_hb_rtt(5.0)
+        delta = rs.snapshot_delta()
+        assert delta.get("hb_rtt_ms") == 5.0
+        assert rs.snapshot_delta() == {}
+        rs.requeue_delta(delta)  # the ship failed: put it back
+        assert rs.snapshot_delta().get("hb_rtt_ms") == 5.0
+
+    def test_profile_skipped_drains_once(self):
+        rs = RunnerStats()
+        rs.note_profile_skipped("t9")
+        assert rs.snapshot_delta()["profile_skipped"] == ["t9"]
+        assert "profile_skipped" not in rs.snapshot_delta()
+
+    def test_trial_end_transition_ships_as_none(self):
+        """The delta encoding must be able to ship a field BACK to None:
+        after trial_end an idle runner must not be reported as still
+        running its last trial forever."""
+        rs = RunnerStats()
+        rs.trial_start("abc")
+        rs.on_broadcast(0)
+        assert rs.snapshot_delta()["trial"] == "abc"
+        rs.trial_end("abc")
+        delta = rs.snapshot_delta()
+        assert "trial" in delta and delta["trial"] is None
+        assert delta["trials_done"] == 1
+
+    def test_requeued_none_transition_is_not_lost(self):
+        """A failed beat carrying a trial -> None transition must re-ship
+        it: 'never shipped' and 'shipped as None' are different ledger
+        states."""
+        rs = RunnerStats()
+        rs.trial_start("t1")
+        rs.snapshot_delta()
+        rs.trial_end("t1")
+        delta = rs.snapshot_delta()
+        assert delta["trial"] is None
+        rs.requeue_delta(delta)  # the beat failed
+        redelta = rs.snapshot_delta()
+        assert "trial" in redelta and redelta["trial"] is None
+
+    def test_ttfm_resets_per_trial(self):
+        rs = RunnerStats()
+        rs.trial_start("a")
+        rs.on_broadcast(0)
+        rs.trial_end("a")
+        rs.trial_start("b")
+        time.sleep(0.02)
+        rs.on_broadcast(0)
+        assert rs.snapshot()["ttfm_ms"] >= 15
+        assert rs.snapshot()["trials_done"] == 1
+
+
+class TestRunnerStatsMerge:
+    def test_merge_updates_state_gauges_and_journal(self):
+        telem = Telemetry(enabled=True)
+        telem.record_runner_stats(2, {"steps": 5, "hb_rtt_ms": 1.5,
+                                      "rss_mb": 100.0})
+        state = telem.runner_state()
+        assert state[2]["steps"] == 5
+        snap = telem.snapshot(fresh=True)
+        assert snap["runners"][2]["hb_rtt_ms"] == 1.5
+        assert snap["metrics"]["gauges"]["runner.hb_rtt_ms.p2"] == 1.5
+        evs = [e for e in telem.events() if e.get("ev") == "runner_stats"]
+        assert evs and evs[0]["partition"] == 2 and evs[0]["steps"] == 5
+
+    def test_profile_skipped_becomes_trial_event(self):
+        telem = Telemetry(enabled=True)
+        telem.record_runner_stats(0, {"profile_skipped": ["tx"]})
+        evs = [e for e in telem.events()
+               if e.get("phase") == "profile_skipped"]
+        assert evs and evs[0]["trial"] == "tx" and evs[0]["partition"] == 0
+
+    def test_liveness_only_delta_does_not_stamp_progress(self):
+        """The hang watchdog must not be reset by a wedged runner whose
+        heartbeat thread keeps shipping RTT/RSS — only trial-progress
+        fields count."""
+        telem = Telemetry(enabled=True)
+        assert "hb_rtt_ms" not in PROGRESS_KEYS
+        telem.record_runner_stats(0, {"steps": 1})
+        t_progress = telem.last_progress(0)
+        assert t_progress is not None
+        time.sleep(0.01)
+        telem.record_runner_stats(0, {"hb_rtt_ms": 2.0, "rss_mb": 50.0})
+        assert telem.last_progress(0) == t_progress
+
+
+# ------------------------------------------------------------------- checks
+
+
+def _engine(telem, **kw):
+    defaults = dict(hb_interval=0.01, min_partitions=3,
+                    straggler_min_excess_ms=100.0, dump_threads_on_hang=True)
+    defaults.update(kw)
+    return HealthEngine(telem, **defaults)
+
+
+class TestStragglerMad:
+    def _seed_ttfm(self, telem, latencies_ms):
+        for pid, ms in latencies_ms.items():
+            trial = "t{}".format(pid)
+            t0 = 100.0
+            telem.spans.mark(trial, "running", t=t0, partition=pid)
+            telem.spans.mark(trial, "first_metric", t=t0 + ms / 1e3,
+                             partition=pid)
+
+    def test_slow_partition_flagged(self):
+        telem = Telemetry(enabled=True)
+        self._seed_ttfm(telem, {0: 100, 1: 110, 2: 105, 3: 2500})
+        flags = _engine(telem).check()
+        stragglers = [f for f in flags if f["check"] == "straggler"]
+        assert len(stragglers) == 1
+        f = stragglers[0]
+        assert f["partition"] == 3 and f["metric"] == "first_metric_ms"
+        assert f["score"] > 3.5 and f["value_ms"] == 2500
+
+    def test_uniform_fleet_never_flags(self):
+        # Zero MAD: without the absolute excess floor any jitter would
+        # divide into an infinite score.
+        telem = Telemetry(enabled=True)
+        self._seed_ttfm(telem, {0: 100, 1: 100, 2: 100, 3: 101})
+        assert _engine(telem).check() == []
+
+    def test_min_partitions_gate(self):
+        telem = Telemetry(enabled=True)
+        self._seed_ttfm(telem, {0: 100, 1: 5000})
+        assert _engine(telem).check() == []  # 2 < min_partitions=3
+
+    def test_requeued_span_excluded_from_first_metric_scoring(self):
+        """A span keeps its FIRST running timestamp but its LAST
+        partition: a trial killed on partition 3 and rescued by partition
+        0 would otherwise charge the death + re-dispatch interval to the
+        healthy rescuer — the exact inverse of a straggler signal."""
+        telem = Telemetry(enabled=True)
+        self._seed_ttfm(telem, {0: 100, 1: 110, 2: 105})
+        # Trial died on partition 3, requeued, first_metric finally on 0.
+        telem.spans.mark("victim", "running", t=200.0, partition=3)
+        telem.spans.mark("victim", "lost", t=201.0, partition=3)
+        telem.spans.mark("victim", "requeued", t=201.0, partition=3)
+        telem.spans.mark("victim", "first_metric", t=205.0, partition=0)
+        assert _engine(telem).check() == []
+
+    def test_cadence_straggler_from_runner_stats(self):
+        telem = Telemetry(enabled=True)
+        for pid, cad in {0: 50.0, 1: 55.0, 2: 52.0, 3: 900.0}.items():
+            telem.record_runner_stats(pid, {"cadence_ms": cad})
+        flags = _engine(telem).check()
+        assert [f["partition"] for f in flags
+                if f["metric"] == "cadence_ms"] == [3]
+
+
+class TestRttDegradation:
+    def test_degraded_partition_flagged(self):
+        telem = Telemetry(enabled=True)
+        for pid, rtt in {0: 2.0, 1: 2.5, 2: 2.2, 3: 400.0}.items():
+            telem.record_runner_stats(pid, {"hb_rtt_ms": rtt})
+        flags = _engine(telem).check()
+        rtts = [f for f in flags if f["check"] == "hb_rtt"]
+        assert len(rtts) == 1 and rtts[0]["partition"] == 3
+
+    def test_subfloor_noise_ignored(self):
+        # 10x the median but under the absolute floor: sub-ms localhost
+        # jitter must not flag.
+        telem = Telemetry(enabled=True)
+        for pid, rtt in {0: 0.2, 1: 0.25, 2: 0.2, 3: 2.0}.items():
+            telem.record_runner_stats(pid, {"hb_rtt_ms": rtt})
+        assert _engine(telem, rtt_floor_ms=50.0).check() == []
+
+
+class TestHangWatchdog:
+    def test_hang_raised_journaled_with_dump_then_cleared(self):
+        telem = Telemetry(enabled=True)
+        telem.trial_event("a", "running", partition=0)  # stamps progress
+        engine = _engine(telem, hb_interval=0.01, hang_factor=1.0)
+        time.sleep(0.1)  # > startup bound (4 x 1 x 0.01 s), no progress
+        flags = engine.check()
+        assert flags and flags[0]["check"] == "hang"
+        assert flags[0]["trial"] == "a" and flags[0]["partition"] == 0
+        raised = [e for e in telem.events() if e.get("ev") == "health"
+                  and e.get("status") == "raised"]
+        assert len(raised) == 1
+        assert "telemetry-health" not in raised[0]["stacks"] or \
+            raised[0]["stacks"]  # dump present and non-empty
+        # Second check while still hung: no duplicate journal event.
+        engine.check()
+        raised = [e for e in telem.events() if e.get("ev") == "health"
+                  and e.get("status") == "raised"]
+        assert len(raised) == 1
+        # Progress resumes -> flag clears exactly once.
+        telem.trial_event("a", "finalized", partition=0)
+        assert engine.check() == []
+        cleared = [e for e in telem.events() if e.get("ev") == "health"
+                   and e.get("status") == "cleared"]
+        assert len(cleared) == 1 and cleared[0]["check"] == "hang"
+
+    def test_compiling_trial_gets_the_startup_leash(self):
+        """A trial PRE-first_metric is allowed startup_factor x the hang
+        bound: a long first-step XLA compile is silent by nature and must
+        not alarm at the steady-state bound."""
+        telem = Telemetry(enabled=True)
+        telem.trial_event("a", "running", partition=0)
+        engine = _engine(telem, hb_interval=0.01, hang_factor=1.0,
+                         startup_factor=50.0)  # startup bound = 0.5 s
+        time.sleep(0.1)  # over the steady bound, under the startup one
+        assert engine.check() == []
+        # Once first_metric lands, the steady bound applies.
+        telem.trial_event("a", "first_metric", partition=0)
+        time.sleep(0.1)
+        flags = engine.check()
+        assert flags and flags[0]["window"] == "steady"
+
+    def test_requeued_trial_keeps_the_startup_leash(self):
+        """A rescued trial's span carries the dead attempt's first_metric
+        (first-occurrence semantics), but the rescue partition recompiles
+        from scratch — it must be judged at the startup bound, not
+        steady."""
+        telem = Telemetry(enabled=True)
+        telem.trial_event("a", "running", partition=1)
+        telem.trial_event("a", "first_metric", partition=1)
+        telem.trial_event("a", "lost", partition=1)
+        telem.trial_event("a", "requeued", partition=1)
+        telem.trial_event("a", "assigned", partition=0)
+        telem.trial_event("a", "running", partition=0)
+        engine = _engine(telem, hb_interval=0.01, hang_factor=1.0,
+                         startup_factor=50.0)  # startup bound = 0.5 s
+        time.sleep(0.1)  # past steady (0.01 s), inside startup
+        assert engine.check() == []
+
+    def test_stale_runner_stats_pruned_from_fleet_checks(self):
+        """A dead runner's frozen EWMA values must not skew the fleet
+        median or hold an uncloseable flag forever."""
+        telem = Telemetry(enabled=True)
+        for pid, rtt in {0: 2.0, 1: 2.5, 2: 2.2, 3: 400.0}.items():
+            telem.record_runner_stats(pid, {"hb_rtt_ms": rtt})
+        # Partition 3 (the outlier) died long ago.
+        with telem._runner_lock:
+            telem._runner_state[3]["updated_t"] -= 3600.0
+        engine = _engine(telem, hb_interval=0.01)
+        assert [f for f in engine.check() if f["check"] == "hb_rtt"] == []
+
+    def test_idle_partition_never_hangs(self):
+        telem = Telemetry(enabled=True)
+        telem.trial_event("a", "running", partition=0)
+        telem.trial_event("a", "finalized", partition=0)  # no longer held
+        engine = _engine(telem, hb_interval=0.01, hang_factor=1.0)
+        time.sleep(0.05)
+        assert engine.check() == []
+
+    def test_reservations_view_is_authoritative(self):
+        from maggy_tpu.core.rpc import Reservations
+
+        telem = Telemetry(enabled=True)
+        telem.trial_event("a", "running", partition=0)
+        res = Reservations(required=1)
+        res.add({"partition_id": 0})
+        res.assign_trial(0, "a")
+        engine = _engine(telem, hb_interval=0.01, hang_factor=1.0)
+        engine.attach(reservations=res)
+        time.sleep(0.1)
+        assert [f["check"] for f in engine.check()] == ["hang"]
+        # The reservation cleared (FINAL landed): hang resolves even if
+        # the span never saw a finalized phase.
+        res.assign_trial(0, None)
+        assert engine.check() == []
+
+    def test_thread_dump_contains_this_thread(self):
+        dump = thread_dump()
+        assert "test_thread_dump_contains_this_thread" in dump or \
+            "Thread" in dump
+
+
+class TestEngineLifecycleAndSnapshot:
+    def test_periodic_thread_runs_and_closes(self):
+        telem = Telemetry(enabled=True)
+        engine = HealthEngine(telem, hb_interval=0.01, interval_s=0.02)
+        engine.start()
+        deadline = time.monotonic() + 5
+        while engine.checks_run == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        engine.close()
+        assert engine.checks_run >= 1
+
+    def test_snapshot_shape(self):
+        telem = Telemetry(enabled=True)
+        engine = _engine(telem, hb_interval=0.01, hang_factor=1.0)
+        telem.health = engine
+        telem.trial_event("a", "running", partition=0)
+        time.sleep(0.1)
+        engine.check()
+        snap = telem.snapshot(fresh=True)
+        health = snap["health"]
+        assert health["raised_total"] == 1 and len(health["flags"]) == 1
+        # Thread dumps stay OUT of the snapshot (TELEM replies must be
+        # small); they live in the journal event only.
+        assert "stacks" not in health["flags"][0]
+
+
+# ------------------------------------------------------- e2e (real driver)
+
+
+def _train(lr, units, reporter=None):
+    acc = 1.0 - ((lr - 0.1) ** 2 + ((units - 32) / 64.0) ** 2)
+    if reporter is not None:
+        for step in range(3):
+            reporter.broadcast(acc * (step + 1) / 3.0, step=step)
+            time.sleep(0.02)
+    return {"metric": acc}
+
+
+@pytest.mark.timeout(120)
+class TestDriverIntegration:
+    def test_healthy_run_zero_flags_and_runner_stats_land(self, local_env):
+        from maggy_tpu import OptimizationConfig, Searchspace, experiment
+        from maggy_tpu.telemetry import JOURNAL_NAME, read_events
+
+        config = OptimizationConfig(
+            name="health_e2e", num_trials=4, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2]),
+                                    units=("INTEGER", [8, 64])),
+            direction="max", num_workers=2, hb_interval=0.02, seed=3,
+            es_policy="none")
+        result = experiment.lagom(_train, config)
+        assert result["num_trials"] == 4
+        exp_dir = os.path.join(local_env.base_dir,
+                               os.listdir(local_env.base_dir)[0])
+        events = read_events(os.path.join(exp_dir, JOURNAL_NAME))
+        # Runner stats were shipped over heartbeats and journaled with
+        # partition attribution.
+        rstats = [e for e in events if e.get("ev") == "runner_stats"]
+        assert rstats, "no runner_stats events in the journal"
+        assert any(e.get("steps") for e in rstats)
+        assert any(e.get("hb_rtt_ms") is not None for e in rstats)
+        partitions = {e["partition"] for e in rstats}
+        assert partitions <= {0, 1} and partitions
+        # A healthy run journals ZERO health flags.
+        assert [e for e in events if e.get("ev") == "health"
+                and e.get("status") == "raised"] == []
+
+    def test_health_disabled_with_telemetry_off(self, local_env, tmp_path):
+        from maggy_tpu import OptimizationConfig
+        from maggy_tpu.core.driver.optimization_driver import \
+            OptimizationDriver
+        from maggy_tpu.searchspace import Searchspace
+
+        config = OptimizationConfig(
+            name="health_off", num_trials=1, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 1.0])),
+            direction="max", num_workers=1, seed=2, es_policy="none",
+            telemetry=False)
+        drv = OptimizationDriver(config, "app", 0)
+        try:
+            assert drv.health is None
+        finally:
+            drv.stop()
+
+    def test_health_opt_out_flag(self, local_env):
+        from maggy_tpu import OptimizationConfig
+        from maggy_tpu.core.driver.optimization_driver import \
+            OptimizationDriver
+        from maggy_tpu.searchspace import Searchspace
+
+        config = OptimizationConfig(
+            name="health_opt_out", num_trials=1, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 1.0])),
+            direction="max", num_workers=1, seed=2, es_policy="none",
+            health=False)
+        drv = OptimizationDriver(config, "app", 0)
+        try:
+            assert drv.health is None
+            assert "health" not in drv.telemetry.snapshot(fresh=True)
+        finally:
+            drv.stop()
+
+
+# --------------------------------------------------------- monitor surface
+
+
+class _TelemDriver:
+    experiment_done = False
+
+    def enqueue(self, msg):
+        pass
+
+    def get_trial(self, trial_id):
+        return None
+
+    def progress_snapshot(self):
+        return {}
+
+
+class TestMonitorHealthView:
+    def test_health_flag_renders_over_live_telem(self, capsys):
+        from maggy_tpu.core.rpc import OptimizationServer
+
+        telem = Telemetry(enabled=True)
+        telem.trial_event("a", "running", partition=0)
+        telem.record_runner_stats(0, {"steps": 3, "cadence_ms": 51.0,
+                                      "hb_rtt_ms": 1.2, "rss_mb": 99.0})
+        engine = _engine(telem, hb_interval=0.01, hang_factor=1.0)
+        telem.health = engine
+        time.sleep(0.1)
+        engine.check()
+        server = OptimizationServer(num_executors=1)
+        server.attach_driver(_TelemDriver())
+        server.telemetry = telem
+        addr = server.start()
+        try:
+            rc = monitor.main(["--driver", "{}:{}".format(*addr),
+                               "--secret", server.secret_hex,
+                               "--once", "--health"])
+        finally:
+            server.stop()
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "1 active flag(s)" in out
+        assert "[hang] partition 0" in out
+        assert "runner 0:" in out and "rss=99.0" in out

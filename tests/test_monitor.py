@@ -93,3 +93,101 @@ class TestCli:
         rc = monitor.main(["--driver", "{}:{}".format(*addr),
                            "--secret", "deadbeef", "--once"])
         assert rc == 1
+
+
+class TestRenderFormatting:
+    """Formatting pins for render/render_telem: the degenerate snapshots
+    (empty, ERR, disabled) and the conditional lines (requeue recovery
+    only when n>0, torn-line warning only when >0)."""
+
+    def test_render_empty_snapshot_falls_back_to_dict(self):
+        line = monitor.render({"type": "LOG"})
+        assert line == "{}"
+
+    def test_render_hpo_without_best_val(self):
+        line = monitor.render({"num_trials": 10, "finalized": 0,
+                               "best_val": None, "early_stopped": 0})
+        assert "0/10" in line and "best=" not in line
+
+    def test_render_telem_empty_snapshot(self):
+        out = monitor.render_telem({"type": "TELEM", "enabled": True})
+        assert "0 queued / 0 finalized" in out
+        assert "hand-off gap: n/a" in out
+        assert "early-stop reaction: n/a" in out
+
+    def test_render_telem_err_snapshot(self):
+        out = monitor.render_telem({"type": "ERR", "error": "nope"})
+        assert out == "telemetry: nope"
+
+    def test_render_telem_disabled(self):
+        out = monitor.render_telem({"type": "TELEM", "enabled": False})
+        assert "disabled" in out
+
+    def test_requeue_recovery_line_only_when_nonzero(self):
+        base = {"type": "TELEM", "enabled": True,
+                "spans": {"trials": {}, "handoff": {},
+                          "early_stop_reaction": {},
+                          "requeue_recovery": {}}}
+        assert "requeue recovery" not in monitor.render_telem(base)
+        base["spans"]["requeue_recovery"] = {"median_ms": 120.0,
+                                             "p95_ms": 200.0, "n": 2}
+        out = monitor.render_telem(base)
+        assert "requeue recovery: median 120.0 ms / p95 200.0 ms (n=2)" in out
+
+    def test_torn_line_warning_only_when_nonzero(self):
+        base = {"type": "TELEM", "enabled": True, "spans": {},
+                "journal": {"torn_lines": 0}}
+        assert "torn" not in monitor.render_telem(base)
+        base["journal"]["torn_lines"] = 4
+        assert "4 torn/corrupt line(s)" in monitor.render_telem(base)
+
+    def test_health_summary_line_only_when_flagged(self):
+        base = {"type": "TELEM", "enabled": True, "spans": {},
+                "health": {"flags": []}}
+        assert "health:" not in monitor.render_telem(base)
+        base["health"]["flags"] = [{"check": "hang", "partition": 1}]
+        assert "1 active flag(s)" in monitor.render_telem(base)
+
+
+class TestRenderHealth:
+    def test_err_and_disabled_and_engineless(self):
+        assert monitor.render_health({"type": "ERR", "error": "x"}) == \
+            "telemetry: x"
+        assert "disabled" in monitor.render_health(
+            {"type": "TELEM", "enabled": False})
+        assert "engine not running" in monitor.render_health(
+            {"type": "TELEM", "enabled": True})
+
+    def test_flag_lines_per_check_kind(self):
+        snap = {"type": "TELEM", "enabled": True,
+                "health": {"raised_total": 3, "checks_run": 9, "flags": [
+                    {"check": "hang", "partition": 0, "trial": "abc",
+                     "silent_s": 1.2, "bound_s": 0.5},
+                    {"check": "straggler", "partition": 2,
+                     "metric": "first_metric_ms", "value_ms": 2500.0,
+                     "fleet_median_ms": 105.0, "score": 15.2},
+                    {"check": "hb_rtt", "partition": 1, "value_ms": 400.0,
+                     "fleet_median_ms": 2.2},
+                ]},
+                "runners": {0: {"trial": "abc", "steps": 7,
+                                "cadence_ms": 51.0, "ttfm_ms": 120.0,
+                                "hb_rtt_ms": 1.2, "rss_mb": 99.0}}}
+        out = monitor.render_health(snap)
+        assert "3 active flag(s), 3 raised total, 9 checks run" in out
+        assert "[hang] partition 0: trial abc silent 1.2s" in out
+        assert "[straggler] partition 2: first_metric_ms 2500.0 ms" in out
+        assert "[hb_rtt] partition 1: heartbeat RTT 400.0 ms" in out
+        assert "runner 0: trial=abc steps=7" in out
+
+    def test_healthy_snapshot_renders_clean(self):
+        snap = {"type": "TELEM", "enabled": True,
+                "health": {"raised_total": 0, "checks_run": 4, "flags": []},
+                "runners": {}}
+        out = monitor.render_health(snap)
+        assert "0 active flag(s)" in out
+
+    def test_health_and_logs_flags_conflict(self, capsys):
+        with pytest.raises(SystemExit):
+            monitor.main(["--driver", "127.0.0.1:1", "--secret", "00",
+                          "--health", "--logs"])
+        assert "--logs" in capsys.readouterr().err

@@ -955,3 +955,82 @@ class TestElasticMigration:
         edriver.periodic_check()
         assert 1 in edriver._resize_watch
         assert edriver._resize_inflight.get(4) == 1
+
+
+class TestVerbTimingConformance:
+    """Every verb registered in a server's handler map must show up as an
+    ``rpc.handle_ms.<verb>`` histogram after one dispatch — a new verb
+    (like the health/runner-stats fields this stack added) cannot land
+    unobserved. The timing is recorded in a ``finally``, so even a
+    handler that errors is timed."""
+
+    #: Minimal well-formed payload per verb. A NEW verb must be added
+    #: here (the test fails loudly otherwise) — that is the point: verb
+    #: registration and observability travel together.
+    PAYLOADS = {
+        "QUERY": {},
+        "JOIN": {"partition_id": -1},
+        "TELEM": {},
+        "REG": {"partition_id": 0},
+        "METRIC": {"partition_id": 0, "trial_id": None, "value": None,
+                   "step": None, "logs": []},
+        "FINAL": {"partition_id": 0, "trial_id": "t", "value": 1.0,
+                  "logs": []},
+        "GET": {"partition_id": 0},
+        "LOG": {},
+        "DIST_CONFIG": {},
+    }
+
+    @pytest.mark.parametrize("server_cls", [Server, OptimizationServer,
+                                            DistributedServer])
+    def test_every_registered_verb_is_timed(self, server_cls):
+        from maggy_tpu.telemetry import Telemetry
+
+        server = server_cls(num_executors=1)
+        if hasattr(server, "attach_driver"):
+            server.attach_driver(FakeDriver())
+        server.telemetry = Telemetry(enabled=True)
+        addr = server.start()
+        try:
+            sock = socket.create_connection(addr, timeout=10)
+            try:
+                for verb in sorted(server._handlers):
+                    assert verb in self.PAYLOADS, (
+                        "verb {} has no conformance payload: add one here "
+                        "so it stays observed".format(verb))
+                    MessageSocket.send_msg(
+                        sock, {"type": verb, **self.PAYLOADS[verb]},
+                        server.secret)
+                    MessageSocket.recv_msg(sock, server.secret)
+            finally:
+                sock.close()
+            hists = server.telemetry.metrics.snapshot()["histograms"]
+            for verb in server._handlers:
+                name = "rpc.handle_ms.{}".format(verb)
+                assert hists.get(name, {}).get("count", 0) >= 1, (
+                    "verb {} was dispatched but never timed".format(verb))
+        finally:
+            server.stop()
+
+    def test_erroring_handler_is_still_timed(self):
+        from maggy_tpu.telemetry import Telemetry
+
+        server = OptimizationServer(num_executors=1)
+        # No driver attached: REG's handler raises AttributeError inside
+        # _dispatch — the ERR reply must still carry a timing sample.
+        server.telemetry = Telemetry(enabled=True)
+        addr = server.start()
+        try:
+            sock = socket.create_connection(addr, timeout=10)
+            try:
+                MessageSocket.send_msg(sock, {"type": "REG",
+                                              "partition_id": 0},
+                                       server.secret)
+                resp = MessageSocket.recv_msg(sock, server.secret)
+            finally:
+                sock.close()
+            assert resp["type"] == "ERR"
+            hists = server.telemetry.metrics.snapshot()["histograms"]
+            assert hists["rpc.handle_ms.REG"]["count"] == 1
+        finally:
+            server.stop()

@@ -245,6 +245,39 @@ class TestJournal:
         events = read_events(path)
         assert len(events) == 1 and events[0]["trial"] == "a"
 
+    def test_torn_lines_counted_not_hidden(self, tmp_path, local_env):
+        """Satellite: skipped lines must be COUNTED — a journal quietly
+        shrinking (corruption beyond the expected torn tail) has to be
+        visible in read_events, replay_journal, and the TELEM snapshot."""
+        path = str(tmp_path / "exp" / "telemetry.jsonl")
+        local_env.dump(
+            '{"t": 1.0, "ev": "trial", "trial": "a", "phase": "queued"}\n'
+            'GARBAGE LINE\n'
+            '[1, 2]\n'   # valid JSON, not an event object
+            '{"t": 2.0, "ev": "trial", "trial": "a", "phase": "finalized"}\n'
+            '{"t": 3.0, "ev"', path)
+        events = read_events(path)
+        assert len(events) == 2
+        assert events.torn_lines == 3
+        replayed = replay_journal(path)
+        assert replayed["torn_lines"] == 3
+        assert replayed["trials"]["finalized"] == 1
+        # A resuming journal surfaces the count in the live snapshot.
+        journal = TelemetryJournal(local_env, path, flush_interval_s=3600)
+        assert journal.load_existing() == 2
+        telem = Telemetry(enabled=True)
+        telem.journal = journal
+        assert telem.snapshot(fresh=True)["journal"]["torn_lines"] == 3
+        journal.close()
+
+    def test_clean_journal_reports_zero_torn_lines(self, tmp_path,
+                                                   local_env):
+        path = str(tmp_path / "exp" / "telemetry.jsonl")
+        local_env.dump('{"t": 1.0, "ev": "trial", "trial": "a", '
+                       '"phase": "queued"}\n', path)
+        assert read_events(path).torn_lines == 0
+        assert replay_journal(path)["torn_lines"] == 0
+
     def test_resume_repairs_torn_tail_instead_of_appending_after_it(
             self, tmp_path, local_env):
         path = str(tmp_path / "exp" / "telemetry.jsonl")
@@ -294,7 +327,12 @@ class TestJournal:
         telem.trial_event("b", "running", partition=0)
         live = telem.snapshot()["spans"]
         telem.close()
-        assert replay_journal(path) == live
+        replayed = replay_journal(path)
+        # replay additionally reports journal health; a clean journal has
+        # zero torn lines and otherwise matches the live derivation bit
+        # for bit.
+        assert replayed.pop("torn_lines") == 0
+        assert replayed == live
 
 
 # ------------------------------------------- driver+runner round trip (e2e)
@@ -370,6 +408,32 @@ class TestDriverRoundTrip:
     def test_telemetry_opt_out(self, local_env):
         _, exp_dir = self._run(local_env, telemetry=False)
         assert not os.path.exists(os.path.join(exp_dir, JOURNAL_NAME))
+
+    def test_trace_export_acceptance(self, local_env):
+        """`python -m maggy_tpu.telemetry trace` on a finished
+        experiment's journal: valid Chrome-trace JSON, >= 1 slice per
+        finalized trial, one track per partition that ran."""
+        from maggy_tpu.telemetry.__main__ import main as telem_cli
+        from maggy_tpu.telemetry.trace import validate_trace
+
+        _, exp_dir = self._run(local_env)
+        out = os.path.join(exp_dir, "trace.json")
+        assert telem_cli(["trace", exp_dir, "-o", out]) == 0
+        with open(out) as f:
+            trace = json.load(f)
+        validate_trace(trace)
+        evs = trace["traceEvents"]
+        finalized = {e["trial"] for e in read_events(
+            os.path.join(exp_dir, JOURNAL_NAME))
+            if e.get("ev") == "trial" and e.get("phase") == "finalized"}
+        sliced = {e["args"]["trial"] for e in evs
+                  if e["ph"] == "X" and e.get("cat") == "trial"}
+        assert finalized and finalized <= sliced
+        tracks = {e["args"]["name"] for e in evs
+                  if e.get("name") == "process_name"}
+        # 2 workers: driver + a track per partition that served a trial.
+        assert "driver" in tracks
+        assert {t for t in tracks if t.startswith("partition")}
 
 
 # ----------------------------------------------------- TELEM RPC + monitor

@@ -1,0 +1,182 @@
+"""Timeline export (maggy_tpu.telemetry.trace): journal events ->
+Chrome-trace/Perfetto JSON — per-partition tracks, trial slices with phase
+sub-slices, instant markers for stops/requeues/chaos/health, counter
+tracks, the validator bench.py gates its artifact on, and the
+``python -m maggy_tpu.telemetry`` CLI."""
+
+import json
+import os
+
+import pytest
+
+from maggy_tpu.telemetry.trace import (DRIVER_PID, build_trace,
+                                       validate_trace, write_trace)
+
+
+def _trial(t, trial, phase, **extra):
+    return {"t": t, "ev": "trial", "trial": trial, "span": "s" + trial,
+            "phase": phase, **extra}
+
+
+def _journal():
+    """Two partitions, two trials each, one early-stop, one requeue after
+    a lost runner, a chaos injection, and a health flag."""
+    return [
+        {"t": 0.0, "ev": "experiment", "phase": "start", "name": "x"},
+        _trial(0.1, "a", "queued"),
+        _trial(0.2, "a", "assigned", partition=0),
+        _trial(0.3, "a", "running", partition=0),
+        _trial(0.9, "a", "first_metric", partition=0),
+        _trial(2.0, "a", "finalized", partition=0, early_stop=True),
+        _trial(0.1, "b", "queued"),
+        _trial(0.2, "b", "assigned", partition=1),
+        _trial(0.3, "b", "running", partition=1),
+        {"t": 0.5, "ev": "chaos", "kind": "kill_runner", "partition": 1,
+         "trial": "b"},
+        _trial(1.2, "b", "lost", partition=1),
+        _trial(1.2, "b", "requeued", partition=1),
+        _trial(2.1, "b", "assigned", partition=0),
+        _trial(2.2, "b", "running", partition=0),
+        _trial(3.0, "b", "finalized", partition=0),
+        {"t": 1.3, "ev": "health", "check": "hang", "partition": 1,
+         "status": "raised", "stacks": "Thread ..."},
+        {"t": 1.0, "ev": "runner_stats", "partition": 0, "steps": 5,
+         "rss_mb": 120.5, "hb_rtt_ms": 1.5},
+        {"t": 4.0, "ev": "experiment", "phase": "finalized"},
+    ]
+
+
+class TestBuildTrace:
+    def test_per_partition_tracks(self):
+        trace = build_trace(_journal())
+        names = {e["args"]["name"] for e in trace["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert names == {"driver", "partition 0", "partition 1"}
+        assert trace["otherData"]["partitions"] == [0, 1]
+
+    def test_one_slice_per_finalized_trial_attempt(self):
+        trace = build_trace(_journal())
+        slices = [e for e in trace["traceEvents"]
+                  if e["ph"] == "X" and e["name"].startswith("trial")]
+        # Trial a: one attempt; trial b: killed attempt on partition 1 +
+        # re-run on partition 0 = three slices total.
+        assert len(slices) == 3
+        by_trial = {}
+        for s in slices:
+            by_trial.setdefault(s["args"]["trial"], []).append(s)
+        assert len(by_trial["a"]) == 1 and len(by_trial["b"]) == 2
+        # The requeued re-run landed on partition 0's track.
+        assert {s["pid"] for s in by_trial["b"]} == {1 + 1, 0 + 1}
+
+    def test_phase_sub_slices_nest_inside_the_trial_slice(self):
+        trace = build_trace(_journal())
+        subs = [e for e in trace["traceEvents"] if e.get("cat") == "phase"
+                and e["args"]["trial"] == "a"]
+        names = {e["name"] for e in subs}
+        assert names == {"dispatch", "startup", "train"}
+        outer = next(e for e in trace["traceEvents"]
+                     if e["ph"] == "X" and e["args"].get("trial") == "a"
+                     and e["cat"] == "trial")
+        for sub in subs:
+            assert sub["pid"] == outer["pid"]
+            assert sub["ts"] >= outer["ts"]
+            assert sub["ts"] + sub["dur"] <= outer["ts"] + outer["dur"]
+        # startup = running -> first_metric = 600 ms.
+        startup = next(e for e in subs if e["name"] == "startup")
+        assert startup["dur"] == pytest.approx(600_000, rel=0.01)
+
+    def test_instants_for_stop_requeue_chaos_health(self):
+        trace = build_trace(_journal())
+        instants = {e["name"] for e in trace["traceEvents"]
+                    if e["ph"] == "i"}
+        assert "chaos:kill_runner" in instants
+        assert "health:hang" in instants
+        assert any(n.startswith("requeued:") for n in instants)
+        assert any(n.startswith("lost:") for n in instants)
+        # Thread dumps never enter the trace args (they'd bloat it).
+        health = next(e for e in trace["traceEvents"]
+                      if e["name"] == "health:hang")
+        assert "stacks" not in health["args"]
+
+    def test_counter_events_from_runner_stats(self):
+        trace = build_trace(_journal())
+        counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+        assert {c["name"] for c in counters} == {"rss_mb", "hb_rtt_ms"}
+        rss = next(c for c in counters if c["name"] == "rss_mb")
+        assert rss["pid"] == 0 + 1 and rss["args"]["rss_mb"] == 120.5
+
+    def test_events_without_partition_land_on_driver_track(self):
+        trace = build_trace(_journal())
+        queued = next(e for e in trace["traceEvents"]
+                      if e["name"].startswith("queued:"))
+        assert queued["pid"] == DRIVER_PID
+
+    def test_timestamps_relative_microseconds_sorted(self):
+        trace = build_trace(_journal())
+        ts = [e["ts"] for e in trace["traceEvents"] if "ts" in e]
+        assert ts == sorted(ts)
+        assert min(ts) == 0
+
+    def test_empty_journal_is_invalid(self):
+        with pytest.raises(ValueError):
+            validate_trace(build_trace([]))
+
+
+class TestValidateTrace:
+    def test_rejects_non_traces(self):
+        with pytest.raises(ValueError):
+            validate_trace({"events": []})
+        with pytest.raises(ValueError):
+            validate_trace({"traceEvents": [{"name": "no-ph"}]})
+        with pytest.raises(ValueError):
+            validate_trace({"traceEvents": [{"ph": "X", "pid": 0}]})
+
+    def test_accepts_and_counts(self):
+        n = validate_trace(build_trace(_journal()))
+        assert n > 10
+
+
+class TestWriteTraceAndCli:
+    def test_write_trace_roundtrips_through_json(self, tmp_path):
+        out = str(tmp_path / "trace.json")
+        n = write_trace(_journal(), out)
+        with open(out) as f:
+            parsed = json.load(f)
+        assert validate_trace(parsed) == n
+
+    def test_cli_trace_on_exp_dir(self, tmp_path, capsys):
+        from maggy_tpu.telemetry import JOURNAL_NAME
+        from maggy_tpu.telemetry.__main__ import main
+
+        exp_dir = str(tmp_path / "exp")
+        os.makedirs(exp_dir)
+        with open(os.path.join(exp_dir, JOURNAL_NAME), "w") as f:
+            for ev in _journal():
+                f.write(json.dumps(ev) + "\n")
+            f.write('{"t": 5.0, "ev"')  # torn tail
+        rc = main(["trace", exp_dir])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "1 torn line(s) skipped" in out
+        with open(os.path.join(exp_dir, "trace.json")) as f:
+            assert validate_trace(json.load(f))
+
+    def test_cli_replay_reports_torn_lines(self, tmp_path, capsys):
+        journal = str(tmp_path / "telemetry.jsonl")
+        with open(journal, "w") as f:
+            for ev in _journal():
+                f.write(json.dumps(ev) + "\n")
+            f.write("CORRUPT\n")
+        from maggy_tpu.telemetry.__main__ import main
+
+        rc = main(["replay", journal])
+        assert rc == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["torn_lines"] == 1
+        assert parsed["trials"]["finalized"] == 2
+
+    def test_cli_missing_journal_fails_loudly(self, tmp_path):
+        from maggy_tpu.telemetry.__main__ import main
+
+        with pytest.raises(FileNotFoundError):
+            main(["trace", str(tmp_path)])
