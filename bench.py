@@ -227,11 +227,16 @@ def scheduling_telemetry(exp_dir, trial_dicts):
         return {
             "handoff": derived.get("handoff") or {},
             "early_stop_reaction": derived.get("early_stop_reaction") or {},
+            # Pipelined hand-off health: prefetch hit/miss counts + hit
+            # rate and controller suggest() latency (empty when the sweep
+            # ran with config.prefetch=False or a pre-pipeline journal).
+            "suggest": derived.get("suggest") or {},
             "source": "telemetry_journal",
             "journal": journal,
         }
     return {"handoff": handoff_gaps(trial_dicts),
             "early_stop_reaction": {},
+            "suggest": {},
             "source": "trial_json_fallback"}
 
 
@@ -521,6 +526,13 @@ def headline_main():
             sched["early_stop_reaction"]["median_ms"],
             sched["early_stop_reaction"]["p95_ms"],
             sched["early_stop_reaction"]["n"]))
+    if sched["suggest"]:
+        log("hand-off pipeline: {} prefetch hits / {} misses (hit rate "
+            "{}), suggest latency {}".format(
+                sched["suggest"].get("prefetch_hits"),
+                sched["suggest"].get("prefetch_misses"),
+                sched["suggest"].get("hit_rate"),
+                sched["suggest"].get("latency")))
     trace_path = _export_trace_artifact(exp_dirs[-1])
 
     # Two interleaved runs per baseline, keeping each baseline's MIN wall:
@@ -554,6 +566,7 @@ def headline_main():
             "early_stopped": result.get("early_stopped", 0),
             "handoff": handoff,
             "early_stop_reaction": sched["early_stop_reaction"],
+            "suggest": sched["suggest"],
             "handoff_source": sched["source"],
             "trace": trace_path,
         },

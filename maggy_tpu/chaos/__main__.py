@@ -9,11 +9,18 @@ the standard soak runs: a runner killed mid-trial, a false preemption,
     python -m maggy_tpu.chaos --seed 7
     python -m maggy_tpu.chaos --plan my_plan.json --trials 20 --workers 4
     python -m maggy_tpu.chaos --stall                    # health-engine soak
+    python -m maggy_tpu.chaos --piggyback                # hand-off soak
     python -m maggy_tpu.chaos --show-schedule --seed 7   # no experiment
 
 ``--stall`` runs the straggler soak instead: one runner frozen mid-trial
 below the heartbeat-loss bound, asserting the live health engine flags
 it (invariant 5, docs/telemetry.md).
+
+``--piggyback`` kills a runner between receiving a TRIAL piggybacked on
+its FINAL reply and that trial's first heartbeat: the assignment exists
+only in the reservation table at kill time, and the soak asserts the
+trial is requeued exactly once (invariant 6) — no lost trial, no
+duplicate FINAL, no double requeue.
 
 ``--show-schedule`` prints the plan's deterministic decision expansion
 (the fingerprint): run it twice with the same seed and diff the output to
@@ -52,6 +59,11 @@ def main(argv=None) -> int:
                     help="run the straggler soak: a runner stalled below "
                          "the loss bound; the health engine must flag it "
                          "(invariant 5)")
+    ap.add_argument("--piggyback", action="store_true",
+                    help="run the pipelined hand-off soak: a runner killed "
+                         "between receiving a piggybacked TRIAL and its "
+                         "first heartbeat; the trial must be requeued "
+                         "exactly once (invariant 6)")
     ap.add_argument("--show-schedule", action="store_true",
                     help="print the plan's deterministic decision "
                          "expansion and exit (no experiment)")
@@ -60,8 +72,10 @@ def main(argv=None) -> int:
     from maggy_tpu.chaos import harness
     from maggy_tpu.chaos.plan import FaultPlan
 
-    if args.plan and args.stall:
-        ap.error("--stall uses the built-in stall plan; drop --plan")
+    if args.plan and (args.stall or args.piggyback):
+        ap.error("--stall/--piggyback use built-in plans; drop --plan")
+    if args.stall and args.piggyback:
+        ap.error("pick one of --stall / --piggyback")
     if args.plan:
         plan = FaultPlan.load(args.plan)
         # A reproduction run must honor the plan file's embedded seed;
@@ -71,6 +85,9 @@ def main(argv=None) -> int:
     elif args.stall:
         plan = harness.stall_plan(seed=7 if args.seed is None
                                   else args.seed)
+    elif args.piggyback:
+        plan = harness.piggyback_plan(seed=7 if args.seed is None
+                                      else args.seed)
     else:
         plan = harness.default_plan(seed=7 if args.seed is None
                                     else args.seed)

@@ -27,6 +27,14 @@ checkable against any soak artifact after the fact):
     between PR 2's fault injection and this PR's live health monitoring:
     a stall the heartbeat-loss scan is too coarse to see must still
     surface.
+6.  **Exactly-once requeue** — N runner-death faults naming a trial
+    produce exactly N requeues of it. The case that motivated it: with
+    the pipelined hand-off (config.prefetch), a runner can die holding a
+    TRIAL it received piggybacked on its FINAL reply, before that
+    trial's first heartbeat — the assignment exists only in the
+    reservation table, and recovery must neither lose it nor requeue it
+    twice (``piggyback_plan``, ``python -m maggy_tpu.chaos
+    --piggyback``).
 """
 
 from __future__ import annotations
@@ -57,6 +65,24 @@ def default_plan(seed: int = 7) -> FaultPlan:
                   trigger={"probability": 0.05}),
         FaultSpec("sever_conn", target={"verb": "FINAL"},
                   trigger={"every_nth": 5}),
+    ], seed=seed)
+
+
+def piggyback_plan(seed: int = 7, nth: int = 4) -> FaultPlan:
+    """A runner killed immediately after RECEIVING a piggybacked TRIAL —
+    in the window between the hand-off and the trial's first heartbeat.
+    With prefetch on (the default), the ``running`` edge is journaled
+    while the FINAL reply carrying the assignment is still being written,
+    so an on_phase=running kill condemns the runner at exactly that
+    window: the assignment sits in the reservation table, the runner's
+    beats go silent before the trial ever heartbeats, and recovery must
+    requeue it EXACTLY once (no lost trial, no duplicate FINAL, no
+    double requeue — invariant 6). ``nth`` defaults past the initial
+    registration GETs (3 workers → edges 1-3 are REG-path) so the killed
+    edge is a piggybacked one."""
+    return FaultPlan([
+        FaultSpec("kill_runner", trigger={"on_phase": "running",
+                                          "nth": nth}),
     ], seed=seed)
 
 
@@ -272,6 +298,7 @@ def check_invariants(events: List[Dict[str, Any]],
                "partition": ce.get("partition")}
         if later:
             rec["outcome"] = "requeued"
+            rec["requeues"] = len(later)
             latency = min(later) - t0
             rec["requeue_latency_s"] = round(latency, 3)
             if requeue_bound_s is not None and latency > requeue_bound_s:
@@ -290,6 +317,22 @@ def check_invariants(events: List[Dict[str, Any]],
                 "journal has no subsequent requeued event".format(
                     ce["kind"], trial, ce.get("partition")))
         recoveries.append(rec)
+
+    # Invariant 6: exactly-once requeue. N runner-death faults naming a
+    # trial must produce exactly N requeues of it — a piggybacked
+    # assignment dying with its runner before the first heartbeat must
+    # not be double-requeued by racing recovery paths (LOST scan vs a
+    # re-registration BLACK), nor silently over-requeued in general.
+    death_faults: Dict[str, int] = {}
+    for ce in chaos_events:
+        if ce.get("kind") in _REQUEUE_KINDS and ce.get("trial") is not None:
+            death_faults[ce["trial"]] = death_faults.get(ce["trial"], 0) + 1
+    for trial, n_faults in sorted(death_faults.items()):
+        n_req = len(requeued.get(trial, []))
+        if n_req > n_faults:
+            violations.append(
+                "duplicate requeue: trial {} was requeued {} times for {} "
+                "runner-death fault(s)".format(trial, n_req, n_faults))
 
     # Invariant 5: stall -> health flag. A frozen runner shorter than the
     # loss bound is invisible to the heartbeat-loss scan; the health

@@ -114,6 +114,16 @@ class OptimizationConfig(LagomConfig):
     chips_per_budget: Optional[Dict[Any, int]] = None
     # Total chips the elastic pool may lease (None -> probe the host).
     total_chips: Optional[int] = None
+    # Pipelined trial hand-off: the driver pre-materializes controller
+    # suggestions on a dedicated suggester thread (up to one per live
+    # runner) and the FINAL reply carries the next TRIAL (or GSTOP)
+    # inline, so the common hand-off costs zero extra round trips and
+    # never waits on a model fit. GET polling remains the fallback
+    # (registration, idle wake-ups, requeues). False restores the
+    # synchronous pre-pipelining behavior exactly; controllers that
+    # override get_suggestion wholesale (no report/suggest split) fall
+    # back automatically. See docs/telemetry.md "Hand-off path".
+    prefetch: bool = True
     # Capture a jax.profiler trace per trial into its TensorBoard dir.
     profile: bool = False
     # Tee the user train_fn's print() calls into the reporter log channel,

@@ -21,7 +21,17 @@ DEFAULT_HEARTBEAT_INTERVAL_S = 1.0
 DRIVER_IDLE_REQUEUE_TICK_S = 0.1
 # First GET retry after a miss; doubles up to DRIVER_IDLE_REQUEUE_TICK_S.
 CLIENT_GET_POLL_MIN_S = 0.005
+# DIST_CONFIG rendezvous poll cap: same fast-start doubling as GET (from
+# CLIENT_GET_POLL_MIN_S), backing off to this once the wait is clearly a
+# still-registering world rather than a race.
+CLIENT_DIST_CONFIG_POLL_MAX_S = 0.5
 CLIENT_POLL_INTERVAL_S = 1.0
+# Pipelined hand-off (config.prefetch): how long the FINAL fast path may
+# wait for the driver's schedule lock before falling back to the worker
+# queue (reply OK, runner GET-polls). The lock is only ever contended
+# while the suggester thread is mid-model-fit, so this bounds the RPC
+# event loop's worst-case stall per FINAL.
+PREFETCH_FINAL_LOCK_TIMEOUT_S = 0.05
 REGISTRATION_TIMEOUT_S = 600.0
 # Bound between an elastic RESIZE request and the respawned runner's
 # REGISTER. A respawn that wedges before registering (e.g. a stale device

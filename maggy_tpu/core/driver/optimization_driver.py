@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import traceback
 from typing import Any, Dict, List, Optional
 
 from maggy_tpu import constants, util
@@ -122,8 +123,46 @@ class OptimizationDriver(Driver):
                        "avg": None, "num_trials": 0, "early_stopped": 0}
         self.job_start: Optional[float] = None
         self.maggy_log = ""
+
+        # ---- pipelined trial hand-off (config.prefetch) ----
+        # The schedule lock serializes everything the single driver-worker
+        # thread used to serialize implicitly, now that three threads can
+        # touch the schedule: the worker (REG/IDLE/BLACK/LOST callbacks +
+        # FINAL fallbacks), the RPC dispatch thread (the FINAL fast path),
+        # and the suggester thread (prefetch refills). Ordering: sched ->
+        # store lock, never the reverse.
+        self._sched_lock = threading.RLock()
+        self._prefetch_enabled = bool(getattr(config, "prefetch", True)) \
+            and getattr(self.controller, "supports_prefetch",
+                        lambda: False)()
+        # The FINAL fast path persists trial.json before the hand-off, on
+        # the RPC event loop — only tolerable when the env's writes are
+        # local fs ops. Remote envs (GCS) keep FINAL processing on the
+        # worker thread; the prefetch queue still feeds it, so only the
+        # piggybacked reply (one GET round trip) is given up.
+        self._inline_final_enabled = self._prefetch_enabled and \
+            getattr(self.env, "FAST_LOCAL_WRITES", False)
+        # Pre-materialized suggestions (oldest first), each stamped with
+        # the controller's schedule_version at suggest time; a FINAL that
+        # bumps the version invalidates the stale entries before dispatch.
+        # Both guarded by _sched_lock.
+        self._prefetched: List[Trial] = []
+        self._prefetch_versions: Dict[str, int] = {}
+        self._suggest_wake = threading.Event()
+        # >0 while the FINAL fast path is executing on the RPC dispatch
+        # thread (mutated under _sched_lock): an expensive suggest() must
+        # fall back to the suggester instead of fitting on the event loop.
+        self._inline_depth = 0
+        self._suggester_thread: Optional[threading.Thread] = None
+
         if getattr(config, "resume", False):
             self._restore_previous_run()
+        if self._prefetch_enabled:
+            # Started AFTER resume restore: the suggester must never
+            # sample from a controller whose state is still rebuilding.
+            self._suggester_thread = threading.Thread(
+                target=self._suggester_loop, daemon=True, name="suggester")
+            self._suggester_thread.start()
 
     # --------------------------------------------------------------- set up
 
@@ -565,9 +604,186 @@ class OptimizationDriver(Driver):
                     return trial
         return None
 
+    # ------------------------------------------- pipelined hand-off (prefetch)
+
+    def _suggester_loop(self) -> None:
+        """Dedicated suggester thread: keeps up to one pre-materialized
+        suggestion per live runner, so an expensive suggest() (Bayes GP
+        fit + acquisition) overlaps with device work instead of stalling
+        whichever runner frees up next. Woken by REG/FINAL/dispatch; the
+        idle tick bounds the wake-up latency when a signal is missed.
+        A controller exception here is the same contract violation it
+        would be on the worker thread: surface it and end the experiment
+        rather than silently losing the pipeline."""
+        while not self.worker_done and not self.experiment_done:
+            try:
+                refilled = self._refill_prefetch()
+            except Exception as exc:  # noqa: BLE001 - mirror the worker contract
+                self.exception = exc
+                self._log("suggester error: {}".format(
+                    traceback.format_exc()))
+                self.experiment_done = True
+                return
+            if not refilled:
+                self._suggest_wake.wait(constants.DRIVER_IDLE_REQUEUE_TICK_S)
+                self._suggest_wake.clear()
+
+    def _prefetch_capacity(self) -> int:
+        """Queue bound: one suggestion per live (registered, unreleased)
+        runner, never more than the executor clamp (which already honors
+        the controller's max_concurrency)."""
+        return min(self.num_executors, self.server.reservations.live_count())
+
+    def _refill_prefetch(self) -> bool:
+        """One refill attempt; True when a suggestion was materialized
+        (the caller loops immediately to top the queue up)."""
+        with self._sched_lock:
+            if self.experiment_done or \
+                    len(self._prefetched) >= self._prefetch_capacity():
+                return False
+            suggestion = self._timed_suggest(source="prefetch")
+            if suggestion in (None, "IDLE"):
+                return False
+            self._admit_prefetched(suggestion)
+            return True
+
+    def _timed_suggest(self, source: str):
+        """controller.suggest() with latency telemetry (sched lock held).
+        Journals an ``ev: "suggest"`` event + the ``suggested`` span edge
+        for every materialized trial; IDLE/None polls only feed the
+        histogram."""
+        t0 = time.monotonic()
+        suggestion = self.controller.suggest()
+        ms = (time.monotonic() - t0) * 1e3
+        self.telemetry.observe_ms("controller.suggest_ms", ms)
+        if suggestion in (None, "IDLE"):
+            return suggestion
+        self.telemetry.event("suggest", ms=round(ms, 3), source=source,
+                             trial=suggestion.trial_id)
+        self.telemetry.trial_event(suggestion.trial_id, "suggested")
+        return suggestion
+
+    def _admit_prefetched(self, trial: Trial) -> None:
+        """Commit a prefetched suggestion (sched lock held): it enters the
+        trial store NOW, so controller capacity checks — BO busy-location
+        imputation, ASHA's in-flight rung-0 count — see it as in flight
+        and cannot overshoot the schedule. The span's ``queued`` edge
+        waits for dispatch, so chaos invariant 1 (every queued trial
+        finalizes) is untouched by a later invalidation."""
+        with self._store_lock:
+            clash = self._trial_store.get(trial.trial_id)
+            self._trial_store[trial.trial_id] = trial
+        if clash is not None and clash is not trial:
+            self._log("WARNING: controller re-issued trial id {} while it "
+                      "was still in flight; the schedule may lose an "
+                      "entry".format(trial.trial_id))
+        self._prefetched.append(trial)
+        self._prefetch_versions[trial.trial_id] = getattr(
+            self.controller, "schedule_version", 0)
+
+    def _invalidate_stale_prefetch(self) -> None:
+        """Drop prefetched suggestions minted before the controller's
+        current schedule_version (sched lock held): a FINAL that changed
+        the schedule — ASHA promotion available, pruner stop, experiment
+        done — must not be beaten to the runner by a pre-decision sample.
+        Dropped trials leave the store and go back through
+        controller.recycle(), so buffer-backed schedules lose nothing."""
+        version = getattr(self.controller, "schedule_version", 0)
+        stale = [t for t in self._prefetched
+                 if self._prefetch_versions.get(t.trial_id) != version]
+        if not stale:
+            return
+        for trial in stale:
+            self._prefetched.remove(trial)
+            self._prefetch_versions.pop(trial.trial_id, None)
+            with self._store_lock:
+                self._trial_store.pop(trial.trial_id, None)
+            self.controller.recycle(trial)
+        self.telemetry.event("prefetch_invalidated", n=len(stale),
+                             version=version,
+                             trials=[t.trial_id for t in stale])
+        self.telemetry.metrics.counter("prefetch.invalidated").inc(len(stale))
+        self._suggest_wake.set()
+
+    def _ingest_final_report(self, last_trial: Trial) -> None:
+        """The FINAL-path half of the split controller contract (sched
+        lock held): rung/pruner/member bookkeeping, then stale-prefetch
+        invalidation against the post-report schedule version."""
+        self.controller.report(last_trial)
+        self._invalidate_stale_prefetch()
+
+    def _next_suggestion(self):
+        """Controller-sourced candidate for a hand-off (sched lock held):
+        the oldest still-valid prefetched suggestion when available, else
+        a live suggest() — unless this is the RPC fast path and the
+        controller is expensive (a GP fit must never run on the event
+        loop; the reply falls back to OK and the suggester refills while
+        the freed runner GET-polls)."""
+        if self._prefetched:
+            trial = self._prefetched.pop(0)
+            self._prefetch_versions.pop(trial.trial_id, None)
+            self._suggest_wake.set()  # a queue slot opened
+            return trial
+        if self._inline_depth > 0 and \
+                getattr(self.controller, "SUGGEST_COST", "cheap") == "expensive":
+            self._suggest_wake.set()
+            return "IDLE"
+        return self._timed_suggest(source="inline")
+
+    def process_final_inline(self, msg) -> bool:
+        """RPC-thread FINAL fast path (config.prefetch): finalize the
+        trial, report it to the controller, invalidate stale prefetches,
+        and decide the partition's next assignment — all before the FINAL
+        reply is written, so the reply can carry the hand-off (the server
+        serves the resulting assignment inline; see
+        OptimizationServer._final). Returns True when fully processed
+        (the caller must NOT also enqueue the message); False falls back
+        to the worker path. The bounded lock wait is the event-loop
+        protection: the lock is only contended while the suggester is
+        mid-model-fit, and stalling every runner's heartbeats behind a GP
+        fit is the exact pathology this pipeline removes. Remote envs
+        (slow dump()) are excluded wholesale — persisting trial.json on
+        the event loop would stall every heartbeat per FINAL."""
+        if not self._inline_final_enabled or self.worker_done:
+            return False
+        if not self._sched_lock.acquire(
+                timeout=constants.PREFETCH_FINAL_LOCK_TIMEOUT_S):
+            self.telemetry.metrics.counter("prefetch.lock_fallbacks").inc()
+            # This hand-off really fell back to GET polling: it must count
+            # as a miss, or a Bayes sweep's hit rate would exclude exactly
+            # the fit-contended FINALs misses are most common on.
+            self.telemetry.trial_event(msg.get("trial_id"), "prefetch_miss",
+                                       once=True,
+                                       partition=int(msg["partition_id"]))
+            return False
+        try:
+            self._inline_depth += 1
+            try:
+                self._final_msg_callback(msg)
+            finally:
+                self._inline_depth -= 1
+            return True
+        except Exception as exc:  # noqa: BLE001 - mirror the worker contract
+            self.exception = exc
+            self._log("FINAL fast-path error: {}".format(
+                traceback.format_exc()))
+            self.experiment_done = True
+            return True
+        finally:
+            self._sched_lock.release()
+
     def _final_msg_callback(self, msg) -> None:
         """Finalize trial, persist artifacts, hand the executor new work
-        (reference :369-417)."""
+        (reference :369-417). Runs under the schedule lock in full: the
+        trial-store pop below must never interleave with a suggester-held
+        suggest() iterating the same dict (BO busy locations, ASHA
+        in-flight counts) — on the worker fallback path that overlap is
+        the COMMON case, since the fallback fires exactly because the
+        suggester is mid-fit. Reentrant from process_final_inline."""
+        with self._sched_lock:
+            self._final_msg_locked(msg)
+
+    def _final_msg_locked(self, msg) -> None:
         self.add_executor_logs(msg.get("logs"))
         trial = self.get_trial(msg.get("trial_id"))
         if trial is None:
@@ -674,12 +890,30 @@ class OptimizationDriver(Driver):
         # legitimately run more trials than `num_trials` rung-0 samples.
         if self.experiment_done:
             return
+        with self._sched_lock:
+            self._assign_next_locked(partition_id, last_trial)
+        if self._prefetch_enabled:
+            # Whatever happened (dispatch, finalize, registration), the
+            # prefetch picture may have changed — let the suggester look.
+            self._suggest_wake.set()
+
+    def _assign_next_locked(self, partition_id: int,
+                            last_trial: Optional[Trial]) -> None:
         # Orphaned trials (lost runners) take priority over fresh
         # suggestions — but never swallow a FINAL report: when last_trial is
         # set the controller must see it (ASHA rung bookkeeping, pruner
         # reports) before any reassignment happens.
-        suggestion = "IDLE" if last_trial is None \
-            else self.controller.get_suggestion(last_trial)
+        if last_trial is None:
+            suggestion = "IDLE"
+        elif self._prefetch_enabled:
+            # Split contract: report on the FINAL path (dropping
+            # schedule-stale prefetches), then source the hand-off from
+            # the prefetch queue — suggest() only runs inline when the
+            # queue is dry and the controller is cheap.
+            self._ingest_final_report(last_trial)
+            suggestion = self._next_suggestion()
+        else:
+            suggestion = self.controller.get_suggestion(last_trial)
         state = self._partition_state(partition_id)
         if state != "live":
             # The controller has seen the FINAL; route any fresh suggestion
@@ -721,7 +955,8 @@ class OptimizationDriver(Driver):
                                            requeue="backlog")
                 return
             if last_trial is None:
-                suggestion = self.controller.get_suggestion(None)
+                suggestion = self._next_suggestion() if self._prefetch_enabled \
+                    else self.controller.get_suggestion(None)
             # Only when the controller ALSO has nothing fresh: an idle
             # elastic runner whose size fits no waiting trial migrates
             # toward the waiting work — otherwise its chips stay leased to
@@ -761,8 +996,13 @@ class OptimizationDriver(Driver):
                 # dropped 2 of 9 segments. Make it loud.
                 # ERRORED entries don't count: a controller retrying a
                 # failed unit of work (PBT segment retry) legitimately
-                # re-issues the identical params/id.
-                duplicate = (suggestion.trial_id in self._trial_store
+                # re-issues the identical params/id. A store entry that IS
+                # this object is no collision either — prefetched
+                # suggestions enter the store at admit time and come back
+                # through here at dispatch.
+                existing = self._trial_store.get(suggestion.trial_id)
+                duplicate = ((existing is not None
+                              and existing is not suggestion)
                              or any(t.trial_id == suggestion.trial_id
                                     and t.final_metric is not None
                                     for t in self._final_store))
@@ -875,6 +1115,17 @@ class OptimizationDriver(Driver):
     def _exp_exception_callback(self, exc) -> None:
         self.env.finalize_experiment(self.exp_dir, "FAILED", {"error": repr(exc)})
         raise exc
+
+    def stop(self) -> None:
+        # Retire the suggester BEFORE the base teardown: a mid-wait
+        # suggester must not refill from a stopping controller (and a
+        # mid-fit one gets the join bound; it is a daemon either way).
+        self.experiment_done = True
+        self._suggest_wake.set()
+        t = self._suggester_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        super().stop()
 
     def _result_summary(self, duration: float) -> str:
         """Human-readable final summary (the reference prints one to the

@@ -32,6 +32,12 @@ class AbstractEnv(ABC):
         if engine is not None:
             engine.on_env_write(path)
 
+    #: True when dump() is a cheap local write (sub-ms): latency-sensitive
+    #: callers (the driver's inline FINAL fast path runs on the RPC event
+    #: loop) consult this before persisting artifacts inline; remote
+    #: object-store backends keep their writes off that thread.
+    FAST_LOCAL_WRITES = False
+
     # ------------------------------------------------------------------- fs
 
     def exists(self, path: str) -> bool:
@@ -121,6 +127,8 @@ class LocalEnv(AbstractEnv):
     """Local-filesystem environment (default). Experiment artifacts live
     under ``base_dir`` (default ``~/maggy_tpu_experiments`` or
     ``$MAGGY_TPU_BASE_DIR``)."""
+
+    FAST_LOCAL_WRITES = True
 
     def __init__(self, base_dir: Optional[str] = None):
         self.base_dir = base_dir or os.environ.get(
@@ -270,6 +278,8 @@ class GCSEnv(LocalEnv):
     ``fs`` is injectable — tests drive the full contract against fsspec's
     in-memory filesystem; production omits it and gets gcsfs.
     """
+
+    FAST_LOCAL_WRITES = False  # object-store round trips, not local fs
 
     def __init__(self, base_dir: str, fs=None):
         if not base_dir.startswith("gs://"):

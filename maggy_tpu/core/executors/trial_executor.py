@@ -9,6 +9,11 @@ GSTOP; ablation mode resolves declarative ablation specs before the call
 (:103-108).
 
 Redesign notes:
+- the hand-off is pipelined (config.prefetch, default on): finalize_metric
+  banks the next assignment piggybacked on the FINAL reply, so the
+  get_suggestion at the top of the loop is usually wire-free — GET polling
+  remains the fallback (first trial after registration, idle wake-ups,
+  requeues).
 - `builtins.print` is NOT patched by default (reference :71-81): the
   reporter tees to the runner log explicitly; user code gets the reporter
   for logging. ``ship_prints=True`` opts back into the reference behavior
@@ -200,12 +205,11 @@ class TrialExecutor:
                     reporter.log(
                         "Trial {} failed:\n{}".format(trial_id, traceback.format_exc())
                     )
-                    with reporter.lock:
-                        client._request(
-                            {"type": "FINAL", "trial_id": trial_id, "value": None,
-                             "error": True, "logs": reporter.get_data()["logs"]}
-                        )
-                        reporter.reset()
+                    # finalize_error, not a raw FINAL: the reply may
+                    # piggyback this runner's next assignment (pipelined
+                    # hand-off), which the next get_suggestion consumes
+                    # without a round trip.
+                    client.finalize_error(trial_id, reporter)
                 finally:
                     stats.trial_end(trial_id)
                     if ctx is not None:

@@ -189,6 +189,13 @@ class Reservations:
             rec = self._table.get(int(partition_id))
             return rec.get("capacity") if rec else None
 
+    def live_count(self) -> int:
+        """Registered, unreleased partitions — the prefetch pipeline's
+        queue bound (one pre-materialized suggestion per live runner)."""
+        with self.lock:
+            return sum(1 for rec in self._table.values()
+                       if not rec.get("released"))
+
     def capacities(self) -> Dict[int, int]:
         """Count of live (registered, unreleased) runners by capacity."""
         with self.lock:
@@ -675,27 +682,48 @@ class OptimizationServer(Server):
         # lost reply) must not wipe the next trial assigned in between.
         self.reservations.clear_trial_if(msg["partition_id"],
                                          msg.get("trial_id"))
-        self.driver.enqueue(dict(msg))
+        # Pipelined hand-off (config.prefetch): the driver processes the
+        # FINAL inline on this thread — report to the controller, drop any
+        # schedule-stale prefetched suggestion, pick the next assignment —
+        # and the reply carries it, so the freed runner skips the GET
+        # round trip entirely. False = not processed (prefetch off, lock
+        # briefly held by a mid-fit suggester, or an internal error): the
+        # legacy path enqueues to the driver worker and the runner falls
+        # back to GET polling.
+        fast = getattr(self.driver, "process_final_inline", None)
+        if fast is None or not fast(msg):
+            self.driver.enqueue(dict(msg))
+            return {"type": "OK"}
+        pid = msg["partition_id"]
+        telem = self.telemetry
+        reply = self._serve_assigned(pid)
+        if reply is not None:
+            if telem is not None and reply.get("type") == "TRIAL":
+                # once=True: a retried FINAL (lost/severed reply)
+                # re-serves the same undelivered assignment — one
+                # hand-off, one hit, however many deliveries it takes.
+                telem.trial_event(reply["trial_id"], "prefetch_hit",
+                                  once=True, partition=int(pid))
+            return reply
+        if self.driver.experiment_done:
+            # Inline release: the runner's last FINAL doubles as its GSTOP.
+            self.reservations.mark_released(pid)
+            return {"type": "GSTOP"}
+        if telem is not None:
+            # Nothing ready (controller IDLE / rung barrier / expensive
+            # suggest still fitting): the runner falls back to GET.
+            # once=True matches the hit side under retried FINALs.
+            telem.trial_event(msg.get("trial_id"), "prefetch_miss",
+                              once=True, partition=int(pid))
         return {"type": "OK"}
 
-    def _get(self, msg):
-        self.reservations.touch(msg["partition_id"])
-        # Serve an already-assigned trial BEFORE honoring experiment-done:
-        # the last suggestion may be assigned concurrently with another
-        # FINAL ending the experiment, and must still run.
-        trial_id = self.reservations.get_assigned_trial(msg["partition_id"])
+    def _serve_assigned(self, partition_id):
+        """The TRIAL reply for the partition's currently-assigned trial —
+        shared by GET and the FINAL piggyback. None = no assignment (the
+        caller decides between GSTOP/RESIZE/OK)."""
+        trial_id = self.reservations.get_assigned_trial(partition_id)
         if trial_id is None:
-            if self.driver.experiment_done:
-                self.reservations.mark_released(msg["partition_id"])
-                return {"type": "GSTOP"}
-            resize = self.reservations.pop_resize(msg["partition_id"])
-            if resize is not None:
-                # The runner exits and its pool respawns it pinned to
-                # ``chips`` chips; released here so liveness checks ignore
-                # the gap until it re-registers.
-                self.reservations.mark_released(msg["partition_id"])
-                return {"type": "RESIZE", "chips": resize}
-            return {"type": "OK", "trial_id": None}
+            return None
         trial = self.driver.get_trial(trial_id)
         if trial is None:
             return {"type": "OK", "trial_id": None}
@@ -704,7 +732,7 @@ class OptimizationServer(Server):
         # Which runner served it: lets offline analysis (bench.py) compute
         # true per-partition hand-off gaps from the trial.json artifacts.
         with trial.lock:
-            trial.info_dict["partition"] = msg["partition_id"]
+            trial.info_dict["partition"] = partition_id
             info = dict(trial.info_dict)
         telem = self.telemetry
         if telem is not None:
@@ -712,10 +740,30 @@ class OptimizationServer(Server):
             # gap's closing edge (its opening edge is the previous trial's
             # "finalized" on the same partition).
             telem.trial_event(trial.trial_id, "running",
-                              partition=int(msg["partition_id"]))
+                              partition=int(partition_id))
         return {"type": "TRIAL", "trial_id": trial.trial_id,
                 "params": trial.params, "info": info,
                 "span": info.get("span")}
+
+    def _get(self, msg):
+        self.reservations.touch(msg["partition_id"])
+        # Serve an already-assigned trial BEFORE honoring experiment-done:
+        # the last suggestion may be assigned concurrently with another
+        # FINAL ending the experiment, and must still run.
+        reply = self._serve_assigned(msg["partition_id"])
+        if reply is not None:
+            return reply
+        if self.driver.experiment_done:
+            self.reservations.mark_released(msg["partition_id"])
+            return {"type": "GSTOP"}
+        resize = self.reservations.pop_resize(msg["partition_id"])
+        if resize is not None:
+            # The runner exits and its pool respawns it pinned to
+            # ``chips`` chips; released here so liveness checks ignore
+            # the gap until it re-registers.
+            self.reservations.mark_released(msg["partition_id"])
+            return {"type": "RESIZE", "chips": resize}
+        return {"type": "OK", "trial_id": None}
 
     def _log(self, msg):
         return {"type": "LOG", **self.driver.progress_snapshot()}
@@ -843,6 +891,14 @@ class Client:
         self.secret = secret.encode() if isinstance(secret, str) else secret
         self.done = False
         self.last_info: dict = {}
+        # Next assignment piggybacked on a FINAL reply (pipelined
+        # hand-off): (trial_id, params, info), consumed by the next
+        # get_suggestion call without any round trip.
+        self._piggyback: Optional[tuple] = None
+        # Reconnect generation (bumped by _request's reconnect path): lets
+        # pollers notice a reconnect happened mid-loop and restart their
+        # adaptive backoff from the fast end.
+        self.reconnects = 0
         # Runner-side stat buffer (telemetry.runnerstats.RunnerStats),
         # attached by the executor. When set, the heartbeat loop measures
         # its round-trip time into it and piggybacks the delta-encoded
@@ -908,6 +964,7 @@ class Client:
                     last_err = conn_err
                     continue
                 CLIENT_METRICS.counter("rpc.client.reconnects").inc()
+                self.reconnects += 1
                 if target is self._sock:
                     self._sock = fresh
                 elif target is self._hb_sock:
@@ -993,15 +1050,36 @@ class Client:
         """Blocking poll for the next trial; returns (trial_id, params) or
         (None, None) when the experiment is over (reference `rpc.py:537-546`).
 
+        Zero-round-trip fast path: an assignment piggybacked on the last
+        FINAL reply (see ``finalize_metric``) is returned immediately
+        without touching the wire — GET polling is the fallback for
+        registration, idle wake-ups, and requeues.
+
         Adaptive poll: the common miss is the race between this GET and the
         driver worker processing the FINAL we just sent (sub-ms), so the
         first retries come fast (5 ms doubling) and only a genuinely idle
         wait (rung barrier) backs off to the 0.1 s driver tick — per-trial
-        hand-off latency stays in single-digit ms instead of a flat 0.1 s."""
+        hand-off latency stays in single-digit ms instead of a flat 0.1 s.
+        The backoff restarts from the fast end after a reconnect: the
+        post-reconnect state is a fresh race (the driver likely processed
+        our retried message already), not a continuation of the idle wait
+        the decayed tick was calibrated for."""
+        pg = self._piggyback
+        if pg is not None:
+            self._piggyback = None
+            trial_id, params, info = pg
+            self.last_info = info
+            return trial_id, params
+        if self.done:
+            return None, None
         deadline = time.monotonic() + timeout if timeout else None
         delay = constants.CLIENT_GET_POLL_MIN_S
+        reconnect_gen = self.reconnects
         while True:
             resp = self._request({"type": "GET"})
+            if self.reconnects != reconnect_gen:
+                reconnect_gen = self.reconnects
+                delay = constants.CLIENT_GET_POLL_MIN_S
             rtype = resp.get("type")
             if rtype == "GSTOP":
                 self.done = True
@@ -1023,27 +1101,69 @@ class Client:
             delay = min(delay * 2, constants.DRIVER_IDLE_REQUEUE_TICK_S)
 
     def get_dist_config(self, timeout: float = constants.RENDEZVOUS_TIMEOUT_S):
+        """Blocking poll for the coordinator rendezvous config. Same
+        adaptive fast-start poll as GET (the common wait is the last
+        sibling's REG landing milliseconds after ours), backing off to
+        CLIENT_DIST_CONFIG_POLL_MAX_S for a genuinely slow world; resets
+        after a reconnect like GET does."""
         deadline = time.monotonic() + timeout
+        delay = constants.CLIENT_GET_POLL_MIN_S
+        reconnect_gen = self.reconnects
         while time.monotonic() < deadline:
             resp = self._request({"type": "DIST_CONFIG"})
+            if self.reconnects != reconnect_gen:
+                reconnect_gen = self.reconnects
+                delay = constants.CLIENT_GET_POLL_MIN_S
             if resp.get("config"):
                 return resp["config"]
-            time.sleep(0.5)
+            time.sleep(delay)
+            delay = min(delay * 2, constants.CLIENT_DIST_CONFIG_POLL_MAX_S)
         raise TimeoutError("Coordinator rendezvous timed out.")
 
+    def _handle_final_reply(self, resp: Dict[str, Any]) -> None:
+        """Bank a FINAL reply's piggybacked next assignment (TRIAL) or
+        release (GSTOP) so the next get_suggestion is wire-free."""
+        rtype = resp.get("type")
+        if rtype == "TRIAL":
+            self._piggyback = (resp["trial_id"], resp["params"],
+                               resp.get("info", {}))
+        elif rtype == "GSTOP":
+            self.done = True
+
     def finalize_metric(self, metric, reporter,
-                        extra: Optional[Dict[str, Any]] = None) -> None:
+                        extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Send FINAL and reset the reporter atomically under its lock
         (reference `rpc.py:584-593`). ``extra`` merges additional payload
-        fields (e.g. a dist worker's telemetry stats)."""
+        fields (e.g. a dist worker's telemetry stats). The reply may
+        piggyback the next assignment (pipelined hand-off) — banked for
+        the next get_suggestion call — and is returned for callers that
+        want to inspect it."""
         with reporter.lock:
             data = reporter.get_data()
-            self._request(
+            resp = self._request(
                 {"type": "FINAL", "trial_id": reporter.trial_id,
                  "value": metric, "logs": data["logs"],
                  "span": data.get("span"), **(extra or {})}
             )
             reporter.reset()
+        self._handle_final_reply(resp)
+        return resp
+
+    def finalize_error(self, trial_id: str, reporter) -> Dict[str, Any]:
+        """Report a failed trial (train_fn raised): FINAL with the error
+        flag, no metric. Routed through the same reply handling as
+        finalize_metric so an errored trial's freed runner still gets its
+        piggybacked next assignment."""
+        with reporter.lock:
+            data = reporter.get_data()
+            resp = self._request(
+                {"type": "FINAL", "trial_id": trial_id, "value": None,
+                 "error": True, "logs": data["logs"],
+                 "span": data.get("span")}
+            )
+            reporter.reset()
+        self._handle_final_reply(resp)
+        return resp
 
     def get_progress(self) -> Dict[str, Any]:
         return self._request({"type": "LOG"})

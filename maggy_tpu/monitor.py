@@ -103,6 +103,17 @@ def render_telem(snap: Dict[str, Any]) -> str:
         # no requeues and the line would be noise.
         lines.append("requeue recovery: {}".format(
             _fmt_dist(spans["requeue_recovery"])))
+    suggest = spans.get("suggest") or {}
+    if suggest:
+        # Pipelined hand-off health: how many hand-offs rode the FINAL
+        # reply vs fell back to GET, and what a suggest() costs.
+        lines.append(
+            "hand-off pipeline: {} hits / {} misses (hit rate {}), "
+            "suggest {}".format(
+                suggest.get("prefetch_hits", 0),
+                suggest.get("prefetch_misses", 0),
+                suggest.get("hit_rate"),
+                _fmt_dist(suggest.get("latency") or {})))
     hists = (snap.get("metrics") or {}).get("histograms") or {}
     rpc = sorted(((name, h) for name, h in hists.items()
                   if name.startswith("rpc.handle_ms.")),

@@ -24,7 +24,24 @@ from maggy_tpu.trial import Trial
 
 
 class AbstractOptimizer(ABC):
+    #: Cost class of one ``suggest()`` call: "cheap" (dict ops — the driver
+    #: may run it inline on the RPC dispatch thread to piggyback a reply)
+    #: or "expensive" (model fit — suggester thread only).
+    SUGGEST_COST = "cheap"
+
     def __init__(self, seed: Optional[int] = None, pruner=None, pruner_kwargs=None):
+        # Fail at construction, not mid-experiment: before the contract
+        # split, get_suggestion was @abstractmethod and an incomplete
+        # subclass could not even instantiate. Neither method can be
+        # abstract now (each has a working default in terms of the other
+        # side of the split), so enforce the same guarantee explicitly.
+        cls = type(self)
+        if cls.get_suggestion is AbstractOptimizer.get_suggestion and \
+                cls.suggest is AbstractOptimizer.suggest:
+            raise TypeError(
+                "{} must implement suggest() (and optionally report()/"
+                "recycle()), or override get_suggestion() wholesale".format(
+                    cls.__name__))
         # Injected by the driver after construction (reference
         # `optimization_driver.py:87-93`).
         self.searchspace: Optional[Searchspace] = None
@@ -35,24 +52,78 @@ class AbstractOptimizer(ABC):
 
         self.seed = seed
         self.rng = np.random.default_rng(seed)
+        #: Bumped by ``report`` whenever a FINAL changes the upcoming
+        #: schedule (promotion available, pruner stop, experiment done).
+        #: The driver stamps prefetched suggestions with the version at
+        #: suggest time and refuses to dispatch a stale one.
+        self.schedule_version = 0
         self.pruner = None
         self._pruner_name = pruner
         self._pruner_kwargs = pruner_kwargs or {}
         self._log_lines: List[str] = []
 
     # ------------------------------------------------------------- contract
+    #
+    # The contract is SPLIT so the driver can pipeline trial hand-offs:
+    #
+    # - ``report(trial)`` ingests a just-finalized trial (rung/pruner/member
+    #   bookkeeping). It MUST run on the FINAL path, before the freed runner
+    #   is handed new work, and it is cheap by design (dict ops only).
+    # - ``suggest()`` proposes the next Trial / "IDLE" / None and MAY run
+    #   ahead of FINALs (a driver-side prefetcher materializes suggestions
+    #   on a dedicated thread while runners train, so an expensive model
+    #   fit never stalls a freed runner).
+    # - ``recycle(trial)`` takes back a suggestion the driver prefetched
+    #   but will not dispatch (the schedule changed underneath it — see
+    #   ``schedule_version``); controllers with a finite pre-sampled
+    #   schedule push the config back so no schedule entry is lost.
+    #
+    # ``get_suggestion(trial)`` is kept as the legacy single-call form
+    # (report + suggest); subclasses that override it wholesale opt OUT of
+    # prefetching (``supports_prefetch`` returns False) and get the
+    # synchronous driver path.
 
     @abstractmethod
     def initialize(self) -> None:
         """Called once by the driver before any suggestions are requested."""
 
-    @abstractmethod
-    def get_suggestion(self, trial: Optional[Trial] = None):
-        """Return the next Trial, "IDLE" (ask again later), or None (done).
+    def report(self, trial: Trial) -> None:
+        """Ingest a finalized (or errored) trial: schedule bookkeeping that
+        must happen before the reporting runner is handed new work.
+        Controllers whose ``suggest`` reads only ``final_store`` (already
+        updated by the driver) need nothing here. Implementations that
+        change the upcoming schedule (an ASHA promotion becoming available,
+        the experiment finishing) must bump ``schedule_version`` so the
+        driver drops stale prefetched suggestions instead of dispatching
+        them."""
 
-        ``trial`` is the just-finalized trial, if any (reference
-        `abstractoptimizer.py:62-75`).
-        """
+    def suggest(self):
+        """Return the next Trial, "IDLE" (ask again later), or None (no
+        more work). May be called ahead of FINALs by the prefetcher; the
+        driver serializes all calls, so no internal locking is needed."""
+        raise NotImplementedError
+
+    def recycle(self, trial: Trial) -> None:
+        """Take back a suggestion the driver prefetched but invalidated
+        before dispatch. Default: drop it (samplers re-draw to fill their
+        schedule); buffer-backed controllers re-queue the config."""
+
+    def get_suggestion(self, trial: Optional[Trial] = None):
+        """Legacy single-call form: return the next Trial, "IDLE" (ask
+        again later), or None (done). ``trial`` is the just-finalized
+        trial, if any (reference `abstractoptimizer.py:62-75`)."""
+        if trial is not None:
+            self.report(trial)
+        return self.suggest()
+
+    def supports_prefetch(self) -> bool:
+        """True when this controller implements the split report/suggest
+        contract (the default ``get_suggestion`` is untouched) — the
+        precondition for the driver's prefetch pipeline. Subclasses that
+        override ``get_suggestion`` wholesale fall back to the synchronous
+        path."""
+        return type(self).get_suggestion is AbstractOptimizer.get_suggestion \
+            and type(self).suggest is not AbstractOptimizer.suggest
 
     def finalize_experiment(self, trials: List[Trial]) -> None:
         """Called once after the experiment completes."""

@@ -50,6 +50,10 @@ class Asha(AbstractOptimizer):
         while b * reduction_factor <= resource_max * (1 + 1e-9):
             b *= reduction_factor
             self.max_rung += 1
+        # A survivor reached the top rung: the experiment is over. Set by
+        # report(), consumed by suggest() — the split keeps the done
+        # decision on the FINAL path while sampling may run ahead.
+        self._exhausted = False
 
     def initialize(self) -> None:
         # rf^max_rung rung-0 samples are the minimum that lets one trial
@@ -66,15 +70,27 @@ class Asha(AbstractOptimizer):
     def rung_budget(self, rung: int) -> float:
         return self.resource_min * (self.reduction_factor ** rung)
 
-    def get_suggestion(self, trial: Optional[Trial] = None):
-        # Bookkeep the just-finalized trial into its rung.
-        if trial is not None and trial.final_metric is not None:
-            rung = trial.info_dict.get("rung", 0)
-            self.rungs.setdefault(rung, []).append(trial.trial_id)
-            if rung == self.max_rung:
-                return None  # a survivor reached the top — experiment done
+    def report(self, trial: Trial) -> None:
+        """Bookkeep the just-finalized trial into its rung. Bumps
+        ``schedule_version`` when the FINAL changes what suggest() would
+        return next — a survivor reaching the top rung (experiment done) or
+        a promotion becoming available — so the driver invalidates any
+        prefetched rung-0 sample instead of dispatching it ahead of the
+        promotion."""
+        if trial.final_metric is None:
+            return
+        rung = trial.info_dict.get("rung", 0)
+        self.rungs.setdefault(rung, []).append(trial.trial_id)
+        if rung == self.max_rung:
+            self._exhausted = True
+            self.schedule_version += 1
+        elif self._promotable() is not None:
+            self.schedule_version += 1
 
-        # Top-down scan for a promotable trial (reference `asha.py:94-147`).
+    def _promotable(self):
+        """Top-down scan for a promotable (not-yet-promoted) trial:
+        (rung, parent_id), or None (reference `asha.py:94-147`). Pure —
+        promotion is committed by suggest()."""
         metrics = self.get_metrics_dict()  # normalized: lower is better
         for rung in sorted(self.rungs.keys(), reverse=True):
             if rung >= self.max_rung:
@@ -84,22 +100,31 @@ class Asha(AbstractOptimizer):
             if k == 0:
                 continue
             top_k = sorted(finalized, key=lambda tid: metrics[tid])[:k]
-            candidates = [tid for tid in top_k if tid not in self.promoted.get(rung, [])]
+            candidates = [tid for tid in top_k
+                          if tid not in self.promoted.get(rung, [])]
             if candidates:
-                parent_id = candidates[0]
-                self.promoted.setdefault(rung, []).append(parent_id)
-                parent_params = self._lookup_params(parent_id)
-                params = self._strip_budget(parent_params)
-                params["budget"] = self.rung_budget(rung + 1)
-                new_trial = Trial(
-                    params,
-                    info_dict={
-                        "sample_type": "promoted",
-                        "rung": rung + 1,
-                        "parent": parent_id,
-                    },
-                )
-                return new_trial
+                return rung, candidates[0]
+        return None
+
+    def suggest(self):
+        if self._exhausted:
+            return None  # a survivor reached the top — experiment done
+
+        promotable = self._promotable()
+        if promotable is not None:
+            rung, parent_id = promotable
+            self.promoted.setdefault(rung, []).append(parent_id)
+            parent_params = self._lookup_params(parent_id)
+            params = self._strip_budget(parent_params)
+            params["budget"] = self.rung_budget(rung + 1)
+            return Trial(
+                params,
+                info_dict={
+                    "sample_type": "promoted",
+                    "rung": rung + 1,
+                    "parent": parent_id,
+                },
+            )
 
         # No promotion possible: fresh random config at rung 0, unless the
         # sampling budget is exhausted.
@@ -113,6 +138,21 @@ class Asha(AbstractOptimizer):
         params = self.searchspace.get_random_parameter_values(1, rng=self.rng)[0]
         params["budget"] = self.rung_budget(0)
         return Trial(params, info_dict={"sample_type": "random", "rung": 0})
+
+    def recycle(self, trial: Trial) -> None:
+        """Take back an invalidated prefetched suggestion. A PROMOTED trial
+        must un-commit its parent from the promoted ledger — suggest()
+        marked it at materialization, and without this the parent's next
+        rung would silently never run (the rung ladder loses an entry).
+        Dropped rung-0 random samples need nothing: the sampling budget is
+        count-based over final_store + trial_store, so a fresh sample
+        replaces them."""
+        parent = trial.info_dict.get("parent")
+        rung = trial.info_dict.get("rung", 0)
+        if parent is not None and rung > 0:
+            promoted = self.promoted.get(rung - 1, [])
+            if parent in promoted:
+                promoted.remove(parent)
 
     def restore(self, finalized) -> None:
         """Rebuild the rung ladder from a previous run: each finalized trial

@@ -26,6 +26,10 @@ from maggy_tpu.trial import Trial
 
 
 class BaseAsyncBO(AbstractOptimizer):
+    #: A GP/TPE fit takes seconds: the driver must never run suggest()
+    #: inline on the RPC dispatch thread — only on the suggester thread.
+    SUGGEST_COST = "expensive"
+
     def __init__(
         self,
         num_warmup_trials: int = 15,
@@ -65,7 +69,13 @@ class BaseAsyncBO(AbstractOptimizer):
             else self.num_warmup_trials
         self.warmup_buffer = self.searchspace.get_random_parameter_values(n, rng=self.rng)
 
-    def get_suggestion(self, trial: Optional[Trial] = None):
+    def suggest(self):
+        # report() is a no-op: the surrogate trains on final_store (already
+        # updated by the driver before report runs) and in-flight configs
+        # come from trial_store — which includes prefetched trials, so a
+        # suggestion materialized ahead of time is imputed as a busy
+        # location exactly like a dispatched one. The model fit below is
+        # the expensive step the driver's suggester thread exists for.
         if self._experiment_finished():
             return None
 

@@ -7,8 +7,6 @@ used by the driver (:33-43), no pruner support (:47-51).
 
 from __future__ import annotations
 
-from typing import Optional
-
 from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
 from maggy_tpu.searchspace import Searchspace
 from maggy_tpu.trial import Trial
@@ -28,11 +26,18 @@ class GridSearch(AbstractOptimizer):
     def initialize(self) -> None:
         self.config_buffer = self.searchspace.grid()
 
-    def get_suggestion(self, trial: Optional[Trial] = None):
+    def suggest(self):
+        # report() is a no-op: the grid is fixed, so suggestions may be
+        # prefetched arbitrarily far ahead.
         if not self.config_buffer:
             return None
         params = self.config_buffer.pop(0)
         return self.create_trial(params, sample_type="grid")
+
+    def recycle(self, trial: Trial) -> None:
+        # The schedule is exactly the grid: an invalidated prefetch goes
+        # back so no cell is lost.
+        self.config_buffer.insert(0, self._strip_budget(trial.params))
 
     def restore(self, finalized) -> None:
         # The grid is deterministic; drop cells the previous run covered.

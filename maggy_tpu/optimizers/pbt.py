@@ -94,20 +94,34 @@ class PBT(AbstractOptimizer):
 
     # ------------------------------------------------------------ scheduling
 
-    def get_suggestion(self, trial: Optional[Trial] = None):
-        if trial is not None:
-            member = trial.info_dict.get("member")
-            if member is not None and trial.final_metric is not None:
-                self._errors.pop(member, None)
-                if trial.info_dict.get("generation", 0) + 1 < self.generations:
-                    self._pending.append(self._next_segment(trial))
-            elif member is not None:
-                self._handle_segment_error(trial, member)
+    def report(self, trial: Trial) -> None:
+        """Decide the member's next segment against the population seen so
+        far (the async exploit/continue step) — on the FINAL path, so the
+        decision uses this FINAL's metric. Pending segments are appended
+        here, never invalidated: each is a committed link of a member's
+        sequential chain, so a prefetched segment stays valid whatever
+        later FINALs decide (schedule_version is never bumped)."""
+        member = trial.info_dict.get("member")
+        if member is None:
+            return
+        if trial.final_metric is not None:
+            self._errors.pop(member, None)
+            if trial.info_dict.get("generation", 0) + 1 < self.generations:
+                self._pending.append(self._next_segment(trial))
+        else:
+            self._handle_segment_error(trial, member)
+
+    def suggest(self):
         if self._pending:
             return self._pending.pop(0)
         if self._finished():
             return None
         return "IDLE" if self._in_flight() else None
+
+    def recycle(self, trial: Trial) -> None:
+        # A member's chain is sequential: a taken-back segment goes to the
+        # FRONT so the chain cannot reorder.
+        self._pending.insert(0, trial)
 
     def _handle_segment_error(self, trial: Trial, member: int) -> None:
         """A segment ERRORed (train_fn raised). Retry once from the member's

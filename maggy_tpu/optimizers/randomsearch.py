@@ -7,8 +7,6 @@ IDLE/None/promoted/fresh (:47-90), plain buffer pop otherwise (:93-106).
 
 from __future__ import annotations
 
-from typing import Optional
-
 from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
 from maggy_tpu.searchspace import Searchspace
 from maggy_tpu.trial import Trial
@@ -31,13 +29,24 @@ class RandomSearch(AbstractOptimizer):
                 self.num_trials, rng=self.rng
             )
 
-    def get_suggestion(self, trial: Optional[Trial] = None):
+    def suggest(self):
+        # report() is a no-op: the schedule is a pre-sampled buffer (or
+        # pruner-delegated), so nothing about a FINAL changes what comes
+        # next — suggestions may be prefetched arbitrarily far ahead.
         if self.pruner is not None:
-            return self._pruner_suggestion(trial)
+            return self._pruner_suggestion()
         if not self.config_buffer:
             return None
         params = self.config_buffer.pop(0)
         return self.create_trial(params, sample_type="random")
+
+    def recycle(self, trial: Trial) -> None:
+        # The non-pruner schedule is EXACTLY num_trials buffer entries;
+        # dropping an invalidated prefetch would silently shrink it. The
+        # pruner path never invalidates (report is a no-op), so its
+        # bracket slots cannot come back here.
+        if self.pruner is None:
+            self.config_buffer.insert(0, self._strip_budget(trial.params))
 
     def restore(self, finalized) -> None:
         # Same seed => same presampled buffer; drop the configs the previous
@@ -46,7 +55,7 @@ class RandomSearch(AbstractOptimizer):
         # silently over-run the schedule.)
         self.config_buffer = self._drop_executed(self.config_buffer, finalized)
 
-    def _pruner_suggestion(self, trial: Optional[Trial]):
+    def _pruner_suggestion(self):
         """Delegate budget/promotion decisions to the pruner (reference
         `randomsearch.py:47-90`)."""
         next_run = self.pruner.pruning_routine()

@@ -6,8 +6,6 @@ optimizer="none" in the driver registry (`optimization_driver.py:40`).
 
 from __future__ import annotations
 
-from typing import Optional
-
 from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
 from maggy_tpu.trial import Trial
 
@@ -23,11 +21,16 @@ class SingleRun(AbstractOptimizer):
         # their md5 ids differ.
         self._pending = list(range(self.num_trials))
 
-    def get_suggestion(self, trial: Optional[Trial] = None):
+    def suggest(self):
+        # report() is a no-op: the schedule is a fixed index list, so
+        # suggestions may be prefetched arbitrarily far ahead.
         if not self._pending:
             return None
         return self.create_trial({"run_index": self._pending.pop(0)},
                                  sample_type="random")
+
+    def recycle(self, trial: Trial) -> None:
+        self._pending.insert(0, trial.params.get("run_index"))
 
     def restore(self, finalized) -> None:
         # Parallel runners finish out of order: skip exactly the indices

@@ -30,9 +30,15 @@ from typing import Any, Dict, List, Optional
 #: first-occurrence timestamps alone cannot carry it).
 #: ``profile_skipped`` is an annotation, not a lifecycle edge: the runner
 #: reported the trial ran untraced (profiler lock contended).
-PHASES = ("queued", "assigned", "running", "first_metric",
+#: ``suggested`` marks the controller materializing the trial (possibly
+#: well before ``queued`` — the prefetch pipeline runs suggest() ahead of
+#: dispatch); ``prefetch_hit`` marks a hand-off served inline on the FINAL
+#: reply (journaled on the dispatched trial), ``prefetch_miss`` a FINAL
+#: whose freed runner had to fall back to GET polling (journaled on the
+#: finalized trial). hit/(hit+miss) is the pipeline's hit rate.
+PHASES = ("suggested", "queued", "assigned", "running", "first_metric",
           "stop_flagged", "stop_sent", "finalized", "lost", "requeued",
-          "profile_skipped")
+          "profile_skipped", "prefetch_hit", "prefetch_miss")
 
 #: Gaps at or above this bound are scheduling (a runner idling on purpose at
 #: a rung barrier), not hand-off overhead — excluded from the gap stats.
@@ -135,6 +141,11 @@ def derive(events: List[Dict[str, Any]],
     - ``requeue_recovery``: each ``requeued`` occurrence to the SAME
       trial's next ``assigned`` — how fast a lost trial re-enters a
       runner (the recovery-latency edge chaos soaks assert on).
+    - ``suggest``: the hand-off pipeline's health — prefetch hit/miss
+      counts + hit rate (``prefetch_hit``/``prefetch_miss`` phase events)
+      and controller suggest() latency (``ev: "suggest"`` events with an
+      ``ms`` field, recorded by the driver's suggester thread and inline
+      fallback). Empty when the experiment ran without prefetch.
     - ``trials``: lifecycle counts.
     """
     by_partition: Dict[int, List[tuple]] = {}
@@ -148,7 +159,13 @@ def derive(events: List[Dict[str, Any]],
     # them would overstate the schedule.
     created: set = set()
     early: set = set()
+    hits = misses = 0
+    suggest_ms: List[float] = []
     for ev in events:
+        if ev.get("ev") == "suggest":
+            if ev.get("ms") is not None:
+                suggest_ms.append(float(ev["ms"]))
+            continue
         if ev.get("ev") != "trial":
             continue
         phase, t, trial = ev.get("phase"), ev.get("t"), ev.get("trial")
@@ -164,6 +181,10 @@ def derive(events: List[Dict[str, Any]],
             assigned_at.setdefault(trial, []).append(t)
         elif phase == "stop_flagged":
             stop_flagged.setdefault(trial, t)
+        elif phase == "prefetch_hit":
+            hits += 1
+        elif phase == "prefetch_miss":
+            misses += 1
         elif phase == "lost":
             lost += 1
         elif phase == "requeued":
@@ -201,6 +222,12 @@ def derive(events: List[Dict[str, Any]],
             nxt = next((t for t in marks if t >= t0), None)
             if nxt is not None:
                 recoveries.append((nxt - t0) * 1e3)
+    suggest: Dict[str, Any] = {}
+    if hits or misses or suggest_ms:
+        suggest = {"prefetch_hits": hits, "prefetch_misses": misses,
+                   "hit_rate": round(hits / (hits + misses), 3)
+                   if (hits + misses) else None,
+                   "latency": _dist_stats(suggest_ms)}
     return {
         "trials": {"created": len(created), "finalized": finalized,
                    "early_stopped": len(early), "errors": errors,
@@ -208,4 +235,5 @@ def derive(events: List[Dict[str, Any]],
         "handoff": _dist_stats(gaps),
         "early_stop_reaction": _dist_stats(reactions),
         "requeue_recovery": _dist_stats(recoveries),
+        "suggest": suggest,
     }

@@ -45,8 +45,13 @@ _SUB_SLICES = (
 )
 
 #: Trial phases rendered as instant markers rather than slice edges.
-_INSTANT_PHASES = ("queued", "stop_flagged", "stop_sent", "requeued",
-                   "lost", "profile_skipped")
+#: ``suggested`` lands on the driver track (no partition yet): the visible
+#: distance to the same trial's ``running`` IS the prefetch lead time;
+#: ``prefetch_hit``/``prefetch_miss`` mark each hand-off's path on the
+#: partition track.
+_INSTANT_PHASES = ("suggested", "queued", "stop_flagged", "stop_sent",
+                   "requeued", "lost", "profile_skipped", "prefetch_hit",
+                   "prefetch_miss")
 
 
 def _pid(partition: Optional[int]) -> int:

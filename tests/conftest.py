@@ -40,6 +40,12 @@ def pytest_configure(config):
         "health: live health-engine tests (maggy_tpu.telemetry.health) — "
         "straggler/hang/RTT detection and the stall->flag chaos "
         "invariant. Select with -m health.")
+    config.addinivalue_line(
+        "markers",
+        "perf: scheduling-performance smoke tests with generous CPU "
+        "bounds (e.g. the journal-replayed hand-off gap) — fast enough "
+        "for tier-1, so hand-off regressions fail in CI instead of only "
+        "surfacing in bench.py. Select with -m perf.")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,674 @@
+"""Pipelined trial hand-off: suggestion prefetch, FINAL-reply piggyback,
+off-thread suggester, and the split report/suggest controller contract.
+
+Covers the three layers of the pipeline plus its correctness edges:
+- controller contract: report/suggest equivalence with the legacy
+  get_suggestion, schedule_version invalidation semantics per controller
+  (ASHA promotion, PBT chain segments, RandomSearch buffer recycle);
+- driver: prefetch admit/invalidate/recycle bookkeeping, capacity bound;
+- wire: FINAL replies carry the next TRIAL (or GSTOP) inline, the client
+  banks the piggyback so get_suggestion is wire-free, and
+  config.prefetch=False restores the OK-reply legacy behavior;
+- satellites: GET backoff reset after reconnect, DIST_CONFIG adaptive
+  poll, _pop_requeue capacity filtering, and the tier-1 hand-off gap
+  smoke (perf marker).
+"""
+
+import glob
+import os
+import threading
+import time
+
+import pytest
+
+from maggy_tpu import constants
+from maggy_tpu.config import OptimizationConfig
+from maggy_tpu.core.driver.optimization_driver import OptimizationDriver
+from maggy_tpu.core.environment import EnvSing
+from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+from maggy_tpu.core.rpc import Client
+from maggy_tpu.optimizers import PBT, Asha, GridSearch, RandomSearch
+from maggy_tpu.optimizers.abstractoptimizer import AbstractOptimizer
+from maggy_tpu.searchspace import Searchspace
+from maggy_tpu.trial import Trial
+
+
+def _space():
+    return Searchspace(lr=("DOUBLE", [0.0, 1.0]))
+
+
+def _wire(opt, num_trials, space=None):
+    """Driver-side controller wiring (optimization_driver.py:112-118)."""
+    opt.searchspace = space or _space()
+    opt.num_trials = num_trials
+    opt.trial_store = {}
+    opt.final_store = []
+    opt.direction = "max"
+    opt._initialize(exp_dir=None)
+    return opt
+
+
+def _finalize(opt, trial, metric):
+    """Simulate the driver's FINAL flow: store moves, then report."""
+    trial.final_metric = metric
+    trial.status = Trial.FINALIZED
+    opt.trial_store.pop(trial.trial_id, None)
+    opt.final_store.append(trial)
+    opt.report(trial)
+
+
+# ---------------------------------------------------------------- contract
+
+
+class TestSplitContract:
+    def test_get_suggestion_equals_report_plus_suggest(self):
+        a = _wire(RandomSearch(seed=5), 4)
+        b = _wire(RandomSearch(seed=5), 4)
+        legacy = [a.get_suggestion().params for _ in range(4)]
+        split = []
+        for _ in range(4):
+            t = b.suggest()
+            b.report(t)  # no-op for RandomSearch, but exercised
+            split.append(t.params)
+        assert legacy == split
+
+    def test_builtin_controllers_support_prefetch(self):
+        for opt in (RandomSearch(seed=0), GridSearch(),
+                    Asha(reduction_factor=2, resource_min=1, resource_max=2),
+                    PBT(population=2, generations=2, seed=0)):
+            assert opt.supports_prefetch()
+
+    def test_wholesale_get_suggestion_override_opts_out(self):
+        class Legacy(AbstractOptimizer):
+            def initialize(self):
+                pass
+
+            def get_suggestion(self, trial=None):
+                return None
+
+        assert not Legacy().supports_prefetch()
+
+    def test_contractless_subclass_rejected_at_construction(self):
+        """Neither suggest() nor get_suggestion(): the pre-split
+        @abstractmethod guarantee (fail at instantiation, not mid-run)
+        must survive the contract split."""
+
+        class Empty(AbstractOptimizer):
+            def initialize(self):
+                pass
+
+        with pytest.raises(TypeError, match="suggest"):
+            Empty()
+
+    def test_randomsearch_recycle_preserves_schedule(self):
+        opt = _wire(RandomSearch(seed=9), 3)
+        first = opt.suggest()
+        assert len(opt.config_buffer) == 2
+        opt.recycle(first)
+        assert len(opt.config_buffer) == 3
+        again = opt.suggest()
+        assert again.params == first.params  # front of the buffer
+
+    def test_gridsearch_recycle_preserves_grid(self):
+        space = Searchspace(units=("DISCRETE", [8, 16, 32]))
+        opt = _wire(GridSearch(), 3, space=space)
+        first = opt.suggest()
+        opt.recycle(first)
+        assert opt.suggest().params == first.params
+
+    def test_pbt_recycle_keeps_chain_order(self):
+        opt = _wire(PBT(population=2, generations=2, seed=0), 4)
+        seg = opt.suggest()
+        opt.recycle(seg)
+        assert opt.suggest() is seg
+
+
+class TestAshaInvalidation:
+    """Acceptance: a promotion (or done flip) decided by a FINAL must bump
+    schedule_version so the driver drops stale prefetched samples before
+    dispatch, and the next suggest() returns the promotion."""
+
+    def _asha(self):
+        return _wire(Asha(reduction_factor=2, resource_min=1,
+                          resource_max=2, seed=1), 2)
+
+    def test_promotion_bumps_version_and_wins_next_suggest(self):
+        opt = self._asha()
+        t1 = opt.suggest()
+        opt.trial_store[t1.trial_id] = t1
+        t2 = opt.suggest()
+        opt.trial_store[t2.trial_id] = t2
+        v0 = opt.schedule_version
+        _finalize(opt, t1, 0.9)
+        # One rung-0 FINAL of two: k = 1//2 = 0, nothing promotable yet.
+        assert opt.schedule_version == v0
+        _finalize(opt, t2, 0.5)
+        # Second FINAL makes a promotion available -> version bumped.
+        assert opt.schedule_version > v0
+        nxt = opt.suggest()
+        assert nxt.info_dict["sample_type"] == "promoted"
+        assert nxt.info_dict["parent"] == t1.trial_id  # 0.9 wins (max)
+
+    def test_top_rung_final_flips_done(self):
+        opt = self._asha()
+        t1 = opt.suggest()
+        opt.trial_store[t1.trial_id] = t1
+        t2 = opt.suggest()
+        opt.trial_store[t2.trial_id] = t2
+        _finalize(opt, t1, 0.9)
+        _finalize(opt, t2, 0.5)
+        promoted = opt.suggest()
+        opt.trial_store[promoted.trial_id] = promoted
+        v = opt.schedule_version
+        _finalize(opt, promoted, 0.95)  # max rung reached
+        assert opt.schedule_version > v
+        assert opt.suggest() is None
+
+    def test_recycled_promotion_is_rederivable(self):
+        """An invalidated prefetched PROMOTION must un-commit its parent
+        from the promoted ledger, or the rung ladder silently loses an
+        entry (the parent's next rung would never run)."""
+        opt = self._asha()
+        t1 = opt.suggest()
+        opt.trial_store[t1.trial_id] = t1
+        t2 = opt.suggest()
+        opt.trial_store[t2.trial_id] = t2
+        _finalize(opt, t1, 0.9)
+        _finalize(opt, t2, 0.5)
+        promoted = opt.suggest()
+        assert promoted.info_dict["sample_type"] == "promoted"
+        assert t1.trial_id in opt.promoted[0]
+        opt.recycle(promoted)
+        assert t1.trial_id not in opt.promoted.get(0, [])
+        again = opt.suggest()
+        assert again.info_dict["sample_type"] == "promoted"
+        assert again.info_dict["parent"] == t1.trial_id
+
+    def test_pbt_report_never_invalidates(self):
+        opt = _wire(PBT(population=2, generations=2, seed=3), 4)
+        s0 = opt.suggest()
+        opt.trial_store[s0.trial_id] = s0
+        s1 = opt.suggest()
+        opt.trial_store[s1.trial_id] = s1
+        v0 = opt.schedule_version
+        _finalize(opt, s0, 0.4)
+        # The member's next segment is decided on the FINAL path, but the
+        # other member's prefetched segment stays valid: no version bump.
+        assert opt.schedule_version == v0
+        assert opt._pending  # successor segment queued
+
+
+# ------------------------------------------------------------------ driver
+
+
+@pytest.fixture
+def driver(tmp_path):
+    EnvSing.set_instance(LocalEnv(base_dir=str(tmp_path / "exp")))
+    config = OptimizationConfig(
+        name="prefetch_drv", num_trials=4, optimizer="randomsearch",
+        searchspace=_space(), direction="max", num_workers=2, seed=2,
+        es_policy="none",
+    )
+    drv = OptimizationDriver(config, "app", 0)
+    yield drv
+    drv.stop()
+    EnvSing.reset()
+
+
+class TestDriverPrefetch:
+    def test_capacity_follows_live_runners(self, driver):
+        assert driver._prefetch_enabled
+        assert driver._prefetch_capacity() == 0  # nobody registered
+        driver.server.reservations.add({"partition_id": 0})
+        assert driver._prefetch_capacity() == 1
+        driver.server.reservations.add({"partition_id": 1})
+        assert driver._prefetch_capacity() == 2
+        driver.server.reservations.mark_released(1)
+        assert driver._prefetch_capacity() == 1
+
+    def test_refill_admits_into_store_and_queue(self, driver):
+        driver.server.reservations.add({"partition_id": 0})
+        assert driver._refill_prefetch()
+        assert len(driver._prefetched) == 1
+        trial = driver._prefetched[0]
+        assert driver._trial_store[trial.trial_id] is trial
+        assert not driver._refill_prefetch()  # at capacity
+
+    def test_invalidation_recycles_through_controller(self, driver):
+        driver.server.reservations.add({"partition_id": 0})
+        assert driver._refill_prefetch()
+        trial = driver._prefetched[0]
+        buf_before = len(driver.controller.config_buffer)
+        driver.controller.schedule_version += 1
+        with driver._sched_lock:
+            driver._invalidate_stale_prefetch()
+        assert not driver._prefetched
+        assert trial.trial_id not in driver._trial_store
+        assert len(driver.controller.config_buffer) == buf_before + 1
+
+    def test_dispatch_pops_prefetched_without_dup_warning(self, driver):
+        driver.server.reservations.add({"partition_id": 0})
+        assert driver._refill_prefetch()
+        trial = driver._prefetched[0]
+        driver._assign_next(0, None)
+        assert driver.server.reservations.get_assigned_trial(0) == \
+            trial.trial_id
+        assert not driver._prefetched
+        # Span committed at dispatch, not admit.
+        assert trial.info_dict.get("span") is not None
+
+
+class TestFinalPiggyback:
+    """The wire-level fast path against a real server + client."""
+
+    @pytest.fixture
+    def live(self, tmp_path):
+        EnvSing.set_instance(LocalEnv(base_dir=str(tmp_path / "exp")))
+        config = OptimizationConfig(
+            name="piggyback", num_trials=3, optimizer="randomsearch",
+            searchspace=_space(), direction="max", num_workers=1, seed=4,
+            es_policy="none",
+        )
+        drv = OptimizationDriver(config, "app", 0)
+        addr = drv.server.start()
+        client = Client(addr, 0, 0, 10.0, drv.server.secret_hex)
+        yield drv, client
+        client.stop()
+        drv.stop()
+        EnvSing.reset()
+
+    def test_final_reply_carries_next_trial(self, live):
+        drv, client = live
+        client.register()
+        drv._assign_next(0, None)
+        tid, params = client.get_suggestion(timeout=5)
+        assert tid is not None
+        resp = client._request({"type": "FINAL", "trial_id": tid,
+                                "value": 1.0, "logs": []})
+        assert resp["type"] == "TRIAL"
+        assert resp["trial_id"] != tid
+        assert resp.get("info", {}).get("span")
+
+    def test_last_final_replies_gstop_inline(self, live):
+        drv, client = live
+        client.register()
+        drv._assign_next(0, None)
+        served = set()
+        tid, _ = client.get_suggestion(timeout=5)
+        for _ in range(3):
+            assert tid is not None and tid not in served
+            served.add(tid)
+            resp = client._request({"type": "FINAL", "trial_id": tid,
+                                    "value": 1.0, "logs": []})
+            client._handle_final_reply(resp)
+            if resp["type"] == "GSTOP":
+                break
+            assert resp["type"] == "TRIAL"
+            tid, _ = resp["trial_id"], resp["params"]
+        assert len(served) == 3
+        assert client.done
+        assert drv.experiment_done
+
+    def test_retried_final_reserves_undelivered_assignment(self, live):
+        """At-least-once delivery: a FINAL whose piggybacked reply was
+        lost re-serves the SAME undelivered assignment on retry instead
+        of minting a second one (which would orphan a trial) — and the
+        re-delivery journals no second prefetch_hit (one hand-off, one
+        hit, however many deliveries it takes)."""
+        drv, client = live
+        client.register()
+        drv._assign_next(0, None)
+        tid, _ = client.get_suggestion(timeout=5)
+        first = client._request({"type": "FINAL", "trial_id": tid,
+                                 "value": 1.0, "logs": []})
+        assert first["type"] == "TRIAL"
+        retry = client._request({"type": "FINAL", "trial_id": tid,
+                                 "value": 1.0, "logs": []})
+        assert retry["type"] == "TRIAL"
+        assert retry["trial_id"] == first["trial_id"]
+        hits = [e for e in drv.telemetry.events()
+                if e.get("ev") == "trial" and e.get("phase") == "prefetch_hit"
+                and e.get("trial") == first["trial_id"]]
+        assert len(hits) == 1
+
+    def test_lock_timeout_fallback_counts_as_miss(self, live):
+        """A FINAL that cannot take the schedule lock (suggester mid-fit)
+        really falls back to GET polling — it must journal a
+        prefetch_miss, or a Bayes sweep's hit rate would exclude exactly
+        the contended hand-offs."""
+        drv, client = live
+        client.register()
+        drv._assign_next(0, None)
+        tid, _ = client.get_suggestion(timeout=5)
+        with drv._sched_lock:  # simulate a suggester mid-fit
+            resp = client._request({"type": "FINAL", "trial_id": tid,
+                                    "value": 1.0, "logs": []})
+        assert resp["type"] == "OK"
+        misses = [e for e in drv.telemetry.events()
+                  if e.get("ev") == "trial"
+                  and e.get("phase") == "prefetch_miss"
+                  and e.get("trial") == tid]
+        assert len(misses) == 1
+
+    def test_prefetch_off_restores_ok_reply(self, tmp_path):
+        EnvSing.set_instance(LocalEnv(base_dir=str(tmp_path / "exp")))
+        config = OptimizationConfig(
+            name="legacy", num_trials=3, optimizer="randomsearch",
+            searchspace=_space(), direction="max", num_workers=1, seed=4,
+            es_policy="none", prefetch=False,
+        )
+        drv = OptimizationDriver(config, "app", 0)
+        try:
+            assert not drv._prefetch_enabled
+            assert drv._suggester_thread is None
+            addr = drv.server.start()
+            client = Client(addr, 0, 0, 10.0, drv.server.secret_hex)
+            client.register()
+            drv._assign_next(0, None)
+            tid, _ = client.get_suggestion(timeout=5)
+            resp = client._request({"type": "FINAL", "trial_id": tid,
+                                    "value": 1.0, "logs": []})
+            # Legacy contract: plain OK, next work via GET polling.
+            assert resp["type"] == "OK"
+            client.stop()
+        finally:
+            drv.stop()
+            EnvSing.reset()
+
+    def test_ablation_controller_falls_back(self, tmp_path):
+        """An AbstractAblator has no report/suggest split: prefetch must
+        auto-disable rather than crash."""
+        from maggy_tpu.ablation import AblationStudy
+        from maggy_tpu.config import AblationConfig
+        from maggy_tpu.core.driver.ablation_driver import AblationDriver
+
+        EnvSing.set_instance(LocalEnv(base_dir=str(tmp_path / "exp")))
+        study = AblationStudy("toy", 1, "label")
+        study.features.include("f1", "f2")
+        config = AblationConfig(name="abl", ablation_study=study,
+                                num_workers=1)
+        drv = AblationDriver(config, "app", 0)
+        try:
+            assert not drv._prefetch_enabled
+        finally:
+            drv.stop()
+            EnvSing.reset()
+
+
+class TestPipelineHardening:
+    def test_inline_final_disabled_for_slow_envs(self, tmp_path):
+        """A remote env's dump() is a storage round trip: the FINAL fast
+        path (which persists trial.json on the RPC event loop) must fall
+        back to the worker, while the prefetch queue itself stays on."""
+
+        class SlowEnv(LocalEnv):
+            FAST_LOCAL_WRITES = False
+
+        EnvSing.set_instance(SlowEnv(base_dir=str(tmp_path / "exp")))
+        config = OptimizationConfig(
+            name="slow_env", num_trials=3, optimizer="randomsearch",
+            searchspace=_space(), direction="max", num_workers=1, seed=4,
+            es_policy="none",
+        )
+        drv = OptimizationDriver(config, "app", 0)
+        try:
+            assert drv._prefetch_enabled
+            assert not drv._inline_final_enabled
+            assert not drv.process_final_inline({"partition_id": 0,
+                                                 "trial_id": "x"})
+        finally:
+            drv.stop()
+            EnvSing.reset()
+
+    def test_suggester_exception_ends_experiment(self, tmp_path):
+        """A controller bug on the suggester thread must surface exactly
+        like one on the worker thread — recorded and fatal, not a silent
+        loss of the prefetch pipeline."""
+
+        class Broken(RandomSearch):
+            def suggest(self):
+                raise RuntimeError("controller bug")
+
+        EnvSing.set_instance(LocalEnv(base_dir=str(tmp_path / "exp")))
+        config = OptimizationConfig(
+            name="broken", num_trials=3, optimizer=Broken(seed=1),
+            searchspace=_space(), direction="max", num_workers=1, seed=1,
+            es_policy="none",
+        )
+        drv = OptimizationDriver(config, "app", 0)
+        try:
+            assert drv._prefetch_enabled
+            drv.server.reservations.add({"partition_id": 0})
+            drv._suggest_wake.set()
+            deadline = time.monotonic() + 5
+            while drv.exception is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert isinstance(drv.exception, RuntimeError)
+            assert drv.experiment_done
+        finally:
+            drv.stop()
+            EnvSing.reset()
+
+
+# ------------------------------------------------------------ client-side
+
+
+class _StubReporter:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.trial_id = "t1"
+
+    def get_data(self):
+        return {"metric": None, "step": None, "logs": [], "span": "s1"}
+
+    def reset(self):
+        self.trial_id = None
+
+
+def _bare_client():
+    client = Client.__new__(Client)
+    client.partition_id = 0
+    client.task_attempt = 0
+    client.done = False
+    client.last_info = {}
+    client._piggyback = None
+    client.reconnects = 0
+    return client
+
+
+class TestClientPiggyback:
+    def test_banked_trial_served_without_wire(self):
+        client = _bare_client()
+        calls = []
+
+        def fake_request(msg, sock=None, lock=True):
+            calls.append(msg["type"])
+            return {"type": "TRIAL", "trial_id": "t2", "params": {"x": 1},
+                    "info": {"span": "s2"}}
+
+        client._request = fake_request
+        client.finalize_metric(0.5, _StubReporter())
+        assert calls == ["FINAL"]
+        tid, params = client.get_suggestion()
+        assert (tid, params) == ("t2", {"x": 1})
+        assert client.last_info == {"span": "s2"}
+        assert calls == ["FINAL"]  # no GET round trip
+
+    def test_banked_gstop_ends_without_wire(self):
+        client = _bare_client()
+        client._request = lambda msg, sock=None, lock=True: {"type": "GSTOP"}
+        client.finalize_metric(0.5, _StubReporter())
+        assert client.done
+        assert client.get_suggestion() == (None, None)
+
+    def test_finalize_error_routes_reply(self):
+        client = _bare_client()
+        client._request = lambda msg, sock=None, lock=True: {
+            "type": "TRIAL", "trial_id": "t3", "params": {}, "info": {}}
+        resp = client.finalize_error("t1", _StubReporter())
+        assert resp["type"] == "TRIAL"
+        assert client.get_suggestion()[0] == "t3"
+
+
+class TestAdaptivePolls:
+    """Satellites: GET backoff reset after reconnect; DIST_CONFIG gets the
+    same fast-start adaptive poll (constant in constants.py)."""
+
+    def test_get_backoff_resets_after_reconnect(self, monkeypatch):
+        client = _bare_client()
+        delays = []
+        monkeypatch.setattr("maggy_tpu.core.rpc.time.sleep",
+                            lambda s: delays.append(s))
+        calls = []
+
+        def fake_request(msg, sock=None, lock=True):
+            calls.append(1)
+            if len(calls) == 5:
+                client.reconnects += 1  # reconnect inside _request
+            if len(calls) >= 8:
+                return {"type": "GSTOP"}
+            return {"type": "OK", "trial_id": None}
+
+        client._request = fake_request
+        client.get_suggestion()
+        m = constants.CLIENT_GET_POLL_MIN_S
+        assert delays[:4] == [m, 2 * m, 4 * m, 8 * m]
+        # Post-reconnect: back to the fast tick, NOT the decayed one.
+        assert delays[4] == m
+        assert delays[5] == 2 * m
+
+    def test_dist_config_poll_fast_start_and_cap(self, monkeypatch):
+        client = _bare_client()
+        delays = []
+        monkeypatch.setattr("maggy_tpu.core.rpc.time.sleep",
+                            lambda s: delays.append(s))
+        calls = []
+
+        def fake_request(msg, sock=None, lock=True):
+            calls.append(1)
+            if len(calls) >= 10:
+                return {"type": "DIST_CONFIG", "config": {"ok": 1}}
+            return {"type": "OK", "config": None}
+
+        client._request = fake_request
+        cfg = client.get_dist_config(timeout=30)
+        assert cfg == {"ok": 1}
+        assert delays[0] == constants.CLIENT_GET_POLL_MIN_S
+        assert max(delays) <= constants.CLIENT_DIST_CONFIG_POLL_MAX_S
+        assert constants.CLIENT_DIST_CONFIG_POLL_MAX_S in delays
+
+    def test_request_reconnect_bumps_generation(self, tmp_path):
+        from maggy_tpu.core.rpc import OptimizationServer
+
+        server = OptimizationServer(num_executors=1)
+        addr = server.start()
+        try:
+            client = Client(addr, 0, 0, 10.0, server.secret_hex)
+            assert client.reconnects == 0
+            client._sock.close()  # sever: next request must reconnect
+            client._request({"type": "QUERY"})
+            assert client.reconnects >= 1
+            client.stop()
+        finally:
+            server.stop()
+
+
+# ----------------------------------------------------- requeue capacity
+
+
+class TestPopRequeueCapacity:
+    """Satellite: a requeued trial whose chip need mismatches the asking
+    runner's capacity is skipped but RETAINED, then served to the next
+    matching runner (optimization_driver._pop_requeue)."""
+
+    @pytest.fixture
+    def edriver(self, tmp_path):
+        EnvSing.set_instance(LocalEnv(base_dir=str(tmp_path / "exp")))
+        config = OptimizationConfig(
+            name="requeue_cap", num_trials=4, optimizer="randomsearch",
+            searchspace=_space(), direction="max", num_workers=2, seed=2,
+            es_policy="none", pool="elastic", total_chips=4,
+            chips_per_budget={1: 1, 9: 2},
+        )
+        drv = OptimizationDriver(config, "app", 0)
+        yield drv
+        drv.stop()
+        EnvSing.reset()
+
+    def _orphan(self, drv, budget):
+        trial = Trial({"lr": 0.5, "budget": budget})
+        drv._trial_store[trial.trial_id] = trial
+        drv._requeue.append(trial.trial_id)
+        return trial
+
+    def test_mismatched_capacity_skips_but_retains(self, edriver):
+        trial = self._orphan(edriver, budget=9)  # needs 2 chips
+        assert edriver._pop_requeue(1) is None
+        assert trial.trial_id in edriver._requeue  # retained, not dropped
+        assert edriver._pop_requeue(2) is trial
+        assert trial.trial_id not in edriver._requeue
+
+    def test_matching_entry_served_across_mismatches(self, edriver):
+        big = self._orphan(edriver, budget=9)    # needs 2 chips
+        small = self._orphan(edriver, budget=1)  # needs 1 chip
+        # A 1-chip runner skips the big trial but gets the small one.
+        assert edriver._pop_requeue(1) is small
+        assert big.trial_id in edriver._requeue
+        assert edriver._pop_requeue(2) is big
+
+    def test_assign_next_routes_by_capacity(self, edriver):
+        trial = self._orphan(edriver, budget=9)
+        edriver.server.reservations.add({"partition_id": 0, "capacity": 1})
+        edriver.server.reservations.add({"partition_id": 1, "capacity": 2})
+        # Stop fresh suggestions from masking the requeue path.
+        edriver.controller.config_buffer = []
+        edriver._assign_next(0, None)
+        assert edriver.server.reservations.get_assigned_trial(0) != \
+            trial.trial_id
+        assert trial.trial_id in edriver._requeue
+        edriver._assign_next(1, None)
+        assert edriver.server.reservations.get_assigned_trial(1) == \
+            trial.trial_id
+
+
+# ------------------------------------------------------------- perf smoke
+
+
+def _smoke_train_fn(lr, reporter=None):
+    for step in range(3):
+        time.sleep(0.02)
+        if reporter is not None:
+            reporter.broadcast(lr * (step + 1), step=step)
+    return {"metric": lr}
+
+
+@pytest.mark.perf
+@pytest.mark.timeout(120)
+def test_handoff_gap_smoke(tmp_path):
+    """Tier-1-safe hand-off regression gate: a 6-trial in-process sweep's
+    journal-replayed median hand-off gap must stay under a generous CPU
+    bound, and the pipeline must actually report hits — so a hand-off
+    regression fails fast here instead of only showing in bench.py."""
+    from maggy_tpu import experiment
+    from maggy_tpu.telemetry import JOURNAL_NAME, replay_journal
+
+    base = str(tmp_path / "handoff_smoke")
+    config = OptimizationConfig(
+        name="handoff_smoke", num_trials=6, optimizer="randomsearch",
+        searchspace=_space(), direction="max", num_workers=2, seed=11,
+        hb_interval=0.05, es_policy="none", experiment_dir=base,
+    )
+    result = experiment.lagom(_smoke_train_fn, config)
+    assert result["num_trials"] == 6
+    exp_dir = sorted(d for d in glob.glob(os.path.join(base, "*"))
+                     if os.path.isdir(d))[-1]
+    derived = replay_journal(os.path.join(exp_dir, JOURNAL_NAME))
+    assert derived["trials"]["finalized"] == 6
+    handoff = derived["handoff"]
+    assert handoff, "no hand-off gaps derivable from the journal"
+    # Generous CPU bound: the pipelined path lands well under 10 ms even
+    # on a loaded CI host; 250 ms only catches real regressions (e.g. a
+    # hand-off falling back to a full poll cycle plus driver tick).
+    assert handoff["median_ms"] < 250.0, handoff
+    suggest = derived["suggest"]
+    assert suggest.get("prefetch_hits", 0) >= 1, suggest
