@@ -637,6 +637,39 @@ def chaos_main():
     return 0 if report["ok"] else 1
 
 
+def fleet_main():
+    """``bench.py --fleet``: shared-fleet scheduling soak (see
+    maggy_tpu/fleet/). Runs two concurrent experiments over one 2-runner
+    fleet — a low-priority bulk sweep preempted mid-flight by a
+    high-priority arrival — and prints one JSON line whose detail.fleet
+    block carries the journal-replayed scheduling numbers (queue wait
+    p50/p95, preemption count, share error vs the configured weights).
+    Exit 1 if any fleet invariant is violated."""
+    if "MAGGY_TPU_BASE_DIR" not in os.environ:
+        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
+    _force_cpu_if_requested()
+    from maggy_tpu.fleet.soak import run_fleet_soak
+
+    seed = int(os.environ.get("BENCH_FLEET_SEED", "7"))
+    t0 = time.time()
+    report = run_fleet_soak(seed=seed)
+    print(json.dumps({
+        "metric": "fleet soak (2 experiments / 2 runners, preempt+resume, "
+                  "journal-checked)",
+        "value": 1.0 if report["ok"] else 0.0,
+        "unit": "invariants_ok",
+        "detail": {
+            "seed": seed,
+            "wall_s": round(time.time() - t0, 1),
+            "violations": report["violations"],
+            "results": report["results"],
+            "fleet": report["detail"],
+            "journal": report["journal"],
+        },
+    }), flush=True)
+    return 0 if report["ok"] else 1
+
+
 def extra_main(name):
     """Child process: run ONE extra bench and print its JSON on stdout."""
     if name == "hang":  # test hook: simulates a compile stall / wedged op
@@ -1075,4 +1108,6 @@ if __name__ == "__main__":
         sys.exit(extra_main(sys.argv[sys.argv.index("--extra") + 1]))
     if "--chaos" in sys.argv:
         sys.exit(chaos_main())
+    if "--fleet" in sys.argv:
+        sys.exit(fleet_main())
     sys.exit(main())

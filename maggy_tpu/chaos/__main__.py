@@ -10,7 +10,13 @@ the standard soak runs: a runner killed mid-trial, a false preemption,
     python -m maggy_tpu.chaos --plan my_plan.json --trials 20 --workers 4
     python -m maggy_tpu.chaos --stall                    # health-engine soak
     python -m maggy_tpu.chaos --piggyback                # hand-off soak
+    python -m maggy_tpu.chaos --preempt                  # preemption soak
     python -m maggy_tpu.chaos --show-schedule --seed 7   # no experiment
+
+``--preempt`` runs the graceful-preemption soak: a mid-trial trial is
+preempted through the driver (the fleet scheduler's checkpoint-assisted
+mechanism); invariant 7 asserts exactly one FINAL and a resume from the
+acked checkpoint step, never step 0.
 
 ``--stall`` runs the straggler soak instead: one runner frozen mid-trial
 below the heartbeat-loss bound, asserting the live health engine flags
@@ -64,6 +70,12 @@ def main(argv=None) -> int:
                          "between receiving a piggybacked TRIAL and its "
                          "first heartbeat; the trial must be requeued "
                          "exactly once (invariant 6)")
+    ap.add_argument("--preempt", action="store_true",
+                    help="run the graceful-preemption soak: a mid-trial "
+                         "checkpoint-assisted preemption (the fleet "
+                         "scheduler's mechanism) — exactly one FINAL, and "
+                         "the trial resumes from its checkpoint step, not "
+                         "step 0 (invariant 7)")
     ap.add_argument("--show-schedule", action="store_true",
                     help="print the plan's deterministic decision "
                          "expansion and exit (no experiment)")
@@ -72,10 +84,12 @@ def main(argv=None) -> int:
     from maggy_tpu.chaos import harness
     from maggy_tpu.chaos.plan import FaultPlan
 
-    if args.plan and (args.stall or args.piggyback):
-        ap.error("--stall/--piggyback use built-in plans; drop --plan")
-    if args.stall and args.piggyback:
-        ap.error("pick one of --stall / --piggyback")
+    modes = [m for m in ("stall", "piggyback", "preempt")
+             if getattr(args, m)]
+    if args.plan and modes:
+        ap.error("--{} uses a built-in plan; drop --plan".format(modes[0]))
+    if len(modes) > 1:
+        ap.error("pick one of --stall / --piggyback / --preempt")
     if args.plan:
         plan = FaultPlan.load(args.plan)
         # A reproduction run must honor the plan file's embedded seed;
@@ -88,6 +102,9 @@ def main(argv=None) -> int:
     elif args.piggyback:
         plan = harness.piggyback_plan(seed=7 if args.seed is None
                                       else args.seed)
+    elif args.preempt:
+        plan = harness.preempt_plan(seed=7 if args.seed is None
+                                    else args.seed)
     else:
         plan = harness.default_plan(seed=7 if args.seed is None
                                     else args.seed)
@@ -97,7 +114,11 @@ def main(argv=None) -> int:
                           "schedule": plan.fingerprint()}, indent=2))
         return 0
 
-    if args.pool == "process":
+    if args.preempt:
+        # The preempt soak needs a checkpointing, ctx-aware trial so the
+        # resume provably restarts from the checkpoint step.
+        train_fn = harness.ckpt_train_fn
+    elif args.pool == "process":
         # The train fn must be module-level picklable for spawn.
         train_fn = harness._soak_train_fn
     else:

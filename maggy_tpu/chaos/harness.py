@@ -35,6 +35,19 @@ checkable against any soak artifact after the fact):
     reservation table, and recovery must neither lose it nor requeue it
     twice (``piggyback_plan``, ``python -m maggy_tpu.chaos
     --piggyback``).
+7.  **Preemption resumes from the checkpoint** — every injected
+    ``preempt_trial`` fault (the fleet scheduler's graceful
+    checkpoint-assisted preemption, exercised standalone) is followed by
+    the trial's ``preempted`` ack; a trial that had checkpointed must
+    later carry a ``resumed`` edge whose ``from_step`` equals the
+    preempted checkpoint step (never step 0) — and invariants 1/2 still
+    hold: exactly one FINAL, no lost trial. A trial that never
+    checkpointed simply requeues from scratch. The fleet-level half of
+    the invariant — no admitted experiment starves past the fair-share
+    bound — is checked against the fleet journal by
+    ``maggy_tpu.fleet.soak.run_fleet_soak`` (queue-wait bound over
+    ``replay_fleet_journal``). ``preempt_plan``, ``python -m
+    maggy_tpu.chaos --preempt``.
 """
 
 from __future__ import annotations
@@ -45,8 +58,11 @@ from typing import Any, Callable, Dict, List, Optional
 
 from maggy_tpu.chaos.plan import FaultPlan, FaultSpec
 
-#: Fault kinds that imply the affected trial must be requeued.
-_REQUEUE_KINDS = ("kill_runner", "fake_preemption")
+#: Fault kinds that imply the affected trial must be requeued. A graceful
+#: preempt_trial requeues through the preempted-FINAL ack (reason
+#: "preempted") — unless the trial outran the STOP and finalized first,
+#: the benign completed_before_detection outcome.
+_REQUEUE_KINDS = ("kill_runner", "fake_preemption", "preempt_trial")
 
 
 def default_plan(seed: int = 7) -> FaultPlan:
@@ -96,6 +112,48 @@ def stall_plan(seed: int = 7, duration_s: float = 2.0) -> FaultPlan:
                                            "nth": 2},
                   duration_s=duration_s),
     ], seed=seed)
+
+
+def preempt_plan(seed: int = 7, nth: int = 2) -> FaultPlan:
+    """Graceful checkpoint-assisted preemption (invariant 7): the Nth
+    trial to reach ``first_metric`` is preempted through the driver's
+    ``preempt_partition`` — the same mechanism the fleet scheduler uses,
+    minus the eviction. Pair with ``ckpt_train_fn``: it checkpoints every
+    step BEFORE broadcasting, so when the preempt-flagged STOP lands the
+    acked checkpoint step is >= 1 and the resume provably does not
+    restart from step 0."""
+    return FaultPlan([
+        FaultSpec("preempt_trial", trigger={"on_phase": "first_metric",
+                                            "nth": nth}),
+    ], seed=seed)
+
+
+def ckpt_train_fn(lr, units, reporter=None, ctx=None):
+    """Soak trial that checkpoints each step (TrialCheckpointer's
+    ``checkpoints/<step>/`` layout, written directly so the soak never
+    pays the orbax import) and resumes from ``ctx.resume_step`` after a
+    preemption — the cooperative half of checkpoint-assisted preemption."""
+    import json as _json
+    import os as _os
+    import time as _time
+
+    acc = 1.0 - ((lr - 0.1) ** 2 + ((units - 32) / 64.0) ** 2)
+    start = 0
+    if ctx is not None and ctx.resume_step is not None:
+        state_path = _os.path.join(ctx.trial_dir, "checkpoints",
+                                   str(ctx.resume_step), "state.json")
+        with open(state_path) as f:
+            start = int(_json.load(f)["step"]) + 1
+    for step in range(start, 8):
+        _time.sleep(0.05)
+        if ctx is not None:
+            step_dir = _os.path.join(ctx.trial_dir, "checkpoints", str(step))
+            _os.makedirs(step_dir, exist_ok=True)
+            with open(_os.path.join(step_dir, "state.json"), "w") as f:
+                _json.dump({"step": step}, f)
+        if reporter is not None:
+            reporter.broadcast(acc * (step + 1) / 8.0, step=step)
+    return {"metric": acc}
 
 
 def _soak_train_fn(lr, units, reporter=None):
@@ -228,6 +286,8 @@ def check_invariants(events: List[Dict[str, Any]],
     queued: Dict[str, float] = {}
     finalized: Dict[str, List[float]] = {}
     requeued: Dict[str, List[float]] = {}
+    preempted_evs: Dict[str, List[Dict[str, Any]]] = {}
+    resumed_evs: Dict[str, List[Dict[str, Any]]] = {}
     chaos_events: List[Dict[str, Any]] = []
     health_raised: List[Dict[str, Any]] = []
     health_by_check: Dict[str, int] = {}
@@ -260,6 +320,10 @@ def check_invariants(events: List[Dict[str, Any]],
             queued.setdefault(trial, t)
         elif phase == "requeued":
             requeued.setdefault(trial, []).append(t)
+        elif phase == "preempted":
+            preempted_evs.setdefault(trial, []).append(dict(ev))
+        elif phase == "resumed":
+            resumed_evs.setdefault(trial, []).append(dict(ev))
         elif phase == "finalized":
             finalized.setdefault(trial, []).append(t)
 
@@ -334,6 +398,61 @@ def check_invariants(events: List[Dict[str, Any]],
                 "duplicate requeue: trial {} was requeued {} times for {} "
                 "runner-death fault(s)".format(trial, n_req, n_faults))
 
+    # Invariant 7: checkpoint-assisted preemption. Every preempt_trial
+    # fault must be followed by the trial's graceful ``preempted`` ack
+    # (unless the trial outran the STOP and finalized — benign); a trial
+    # preempted WITH a checkpoint must later resume exactly from that
+    # step (never restart at 0); invariants 1/2 (single FINAL, no lost
+    # trial) already cover the rest of the chain above.
+    preempt_recs: List[Dict[str, Any]] = []
+    for ce in chaos_events:
+        if ce.get("kind") != "preempt_trial":
+            continue
+        trial, t0 = ce.get("trial"), ce.get("t")
+        if trial is None or t0 is None:
+            continue
+        acks = [p for p in preempted_evs.get(trial, [])
+                if p.get("t") is not None and p["t"] >= t0]
+        rec: Dict[str, Any] = {"trial": trial,
+                               "partition": ce.get("partition")}
+        if not acks:
+            if [t for t in finalized.get(trial, []) if t >= t0]:
+                rec["outcome"] = "completed_before_preempt"
+            else:
+                rec["outcome"] = "unacked"
+                violations.append(
+                    "unacked preemption: preempt_trial fault on trial {} "
+                    "produced neither a preempted ack nor a FINAL".format(
+                        trial))
+            preempt_recs.append(rec)
+            continue
+        ack = acks[0]
+        step = ack.get("step")
+        rec.update(outcome="preempted", step=step,
+                   checkpointed=bool(ack.get("checkpointed")))
+        if ack.get("checkpointed"):
+            resumes = [r for r in resumed_evs.get(trial, [])
+                       if r.get("t") is not None and r["t"] >= ack["t"]]
+            if not resumes:
+                violations.append(
+                    "unresumed preemption: trial {} was preempted at "
+                    "checkpoint step {} but never carried a resumed "
+                    "edge".format(trial, step))
+            else:
+                from_step = resumes[0].get("from_step")
+                rec["from_step"] = from_step
+                if from_step != step:
+                    violations.append(
+                        "resume step mismatch: trial {} was preempted at "
+                        "checkpoint step {} but resumed from_step={}"
+                        .format(trial, step, from_step))
+                elif not from_step or from_step < 1:
+                    violations.append(
+                        "resume from scratch: trial {} checkpointed but "
+                        "resumed from step {} (expected >= 1)".format(
+                            trial, from_step))
+        preempt_recs.append(rec)
+
     # Invariant 5: stall -> health flag. A frozen runner shorter than the
     # loss bound is invisible to the heartbeat-loss scan; the health
     # engine's hang watchdog (or straggler scoring) must still see it,
@@ -376,6 +495,7 @@ def check_invariants(events: List[Dict[str, Any]],
                    "requeued": sum(len(v) for v in requeued.values())},
         "faults": {"injected": len(chaos_events), "by_kind": by_kind},
         "recoveries": recoveries,
+        "preemptions": preempt_recs,
         "health": {"engine_ran": health_engine_ran,
                    "raised": len(health_raised),
                    "by_check": health_by_check,

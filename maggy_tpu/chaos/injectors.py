@@ -120,6 +120,7 @@ class ChaosEngine:
                         for i, s in enumerate(plan.specs)]
         self.pool = None
         self.reservations = None
+        self.driver = None  # preempt_trial acts through the driver
         # Cooperative (thread-pool) fault state, consulted by the client
         # hook: condemned partitions die on their next request; stalled
         # ones sleep until the deadline.
@@ -136,15 +137,17 @@ class ChaosEngine:
         #: journal round-trip).
         self.injected: List[Dict[str, Any]] = []
 
-    def attach(self, pool=None, reservations=None) -> None:
+    def attach(self, pool=None, reservations=None, driver=None) -> None:
         """Late-bind the fault surfaces: the pool exists only once
         ``run_experiment`` builds it, the reservations once the server
-        does."""
+        does; the driver carries the graceful-preemption entry point."""
         with self._lock:
             if pool is not None:
                 self.pool = pool
             if reservations is not None:
                 self.reservations = reservations
+            if driver is not None:
+                self.driver = driver
 
     # ------------------------------------------------------------- hook API
 
@@ -322,6 +325,24 @@ class ChaosEngine:
                                                 + spec.duration_s)
             detail["mechanism"] = "sigstop" if stalled else "cooperative"
             detail["duration_s"] = spec.duration_s
+        elif spec.kind == "preempt_trial":
+            # GRACEFUL preemption through the driver: the trial's
+            # early-stop machinery carries a preempt-flagged STOP, the
+            # runner acks with its last checkpoint step, and the driver
+            # requeues the trial to resume there (invariant 7 checks the
+            # preempted -> resumed -> single-FINAL chain).
+            drv = self.driver
+            preempted = None
+            if drv is not None \
+                    and hasattr(drv, "preempt_partition"):
+                try:
+                    preempted = drv.preempt_partition(pid, evict=False)
+                except Exception:  # noqa: BLE001 - injection must never crash the hook
+                    preempted = None
+            if preempted is not None:
+                trial = preempted
+            detail["mechanism"] = "graceful" if preempted is not None \
+                else "noop"
         elif spec.kind == "fake_preemption":
             # The runner stays alive; only the driver's view of its
             # heartbeats is aged — the falsely-declared-lost race. The

@@ -70,6 +70,7 @@ KINDS = (
     "kill_runner",
     "stall_runner",
     "fake_preemption",
+    "preempt_trial",
     "drop_msg",
     "delay_msg",
     "sever_conn",
@@ -78,7 +79,12 @@ KINDS = (
 
 #: Kinds that act on a runner (fired from ticks / phase transitions), as
 #: opposed to per-message / per-write faults evaluated at a hook site.
-RUNNER_KINDS = ("kill_runner", "stall_runner", "fake_preemption")
+#: ``preempt_trial`` exercises the GRACEFUL checkpoint-assisted
+#: preemption path (the fleet scheduler's mechanism): the driver flags
+#: the partition's trial, the runner acks with its checkpoint step, and
+#: the trial must resume from that step — invariant 7.
+RUNNER_KINDS = ("kill_runner", "stall_runner", "fake_preemption",
+                "preempt_trial")
 
 _TRIGGER_KEYS = ("after_s", "nth", "every_nth", "probability", "on_phase")
 

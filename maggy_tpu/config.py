@@ -58,6 +58,16 @@ class LagomConfig:
     #: never see — still surface.
     health_hang_factor: float = 25.0
 
+    #: Shared-fleet attachment (maggy_tpu.fleet): a FleetBinding placed
+    #: here by ``experiment.lagom_submit`` / ``Fleet.submit`` makes the
+    #: driver LEASE runners from the fleet scheduler (weighted fair share,
+    #: priority classes, quotas, checkpoint-assisted preemption) and
+    #: publish its RPC server on the fleet's shared listener. None (the
+    #: default, and always the case for plain ``lagom()``) preserves the
+    #: classic single-tenant behavior bit-for-bit — ``lagom()`` is simply
+    #: a fleet of one that owns its pool.
+    fleet: Any = None
+
     def resolved_hb_loss_timeout(self) -> float:
         """Seconds of heartbeat silence before a runner/worker is
         declared lost: the explicit ``hb_loss_timeout`` field when set

@@ -103,7 +103,8 @@ class Driver(ABC):
             plan = plan_src if isinstance(plan_src, FaultPlan) \
                 else FaultPlan.load(plan_src, env=self.env)
             self.chaos = ChaosEngine(plan, telemetry=self.telemetry)
-            self.chaos.attach(reservations=self.server.reservations)
+            self.chaos.attach(reservations=self.server.reservations,
+                              driver=self)
             # Phase transitions feed on-state-transition triggers.
             self.telemetry.chaos_hook = self.chaos.on_trial_phase
             arm(self.chaos)
@@ -164,7 +165,13 @@ class Driver(ABC):
         try:
             self._exp_startup_callback()
             self.init()
-            pool = self._make_runner_pool()
+            # Fleet mode (config.fleet): the driver LEASES runners from
+            # the shared fleet scheduler instead of owning a pool — the
+            # leased pool registers this experiment's executor and blocks
+            # until completion, exactly like a pool.run would.
+            binding = getattr(self.config, "fleet", None)
+            pool = binding.lease_pool(self) if binding is not None \
+                else self._make_runner_pool()
             self._active_pool = pool
             if self.chaos is not None:
                 # Late-bind the pool: kill/stall faults act through it.
@@ -198,8 +205,15 @@ class Driver(ABC):
             self.stop()
 
     def init(self) -> None:
-        self.server_addr = self.env.connect_host(
-            self.server, host=getattr(self.config, "bind_host", None))
+        binding = getattr(self.config, "fleet", None)
+        if binding is not None:
+            # Fleet mode: this experiment's traffic shares the fleet's ONE
+            # listening socket, routed by which experiment secret
+            # authenticates each frame (rpc.SharedServer).
+            self.server_addr = binding.attach_server(self.server)
+        else:
+            self.server_addr = self.env.connect_host(
+                self.server, host=getattr(self.config, "bind_host", None))
         self._start_worker()
         if getattr(self.config, "verbose", False):
             self._start_progress_printer()

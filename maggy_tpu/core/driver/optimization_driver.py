@@ -786,6 +786,12 @@ class OptimizationDriver(Driver):
     def _final_msg_locked(self, msg) -> None:
         self.add_executor_logs(msg.get("logs"))
         trial = self.get_trial(msg.get("trial_id"))
+        if msg.get("preempted"):
+            # A preemption ack is NOT a finalize: the trial goes back into
+            # the schedule (resuming from its checkpoint step when it has
+            # one), and the controller never sees a report for it.
+            self._preempted_final(msg, trial)
+            return
         if trial is None:
             # Duplicate FINAL (e.g. a falsely-declared-lost runner finishing a
             # trial another runner re-ran, or a retried FINAL whose first
@@ -832,6 +838,92 @@ class OptimizationDriver(Driver):
                       "{}/{}/trial.json".format(self.exp_dir, trial.trial_id))
         self._assign_next(msg["partition_id"], trial)
 
+    def _preempted_final(self, msg, trial: Optional[Trial]) -> None:
+        """Requeue a preempted trial (sched lock held). Idempotent under
+        at-least-once delivery: only a trial whose preempt flag is still
+        armed is processed — a retried ack (severed reply) arrives after
+        reset_run_state cleared it and is ignored. ``step`` is the
+        runner's last checkpoint step: stored on the trial so the TRIAL
+        reply that re-dispatches it ships ``resume_step`` to the next
+        runner (ctx.resume_step); None = it never checkpointed and simply
+        re-runs from scratch."""
+        pid = msg.get("partition_id")
+        if trial is None:
+            return
+        if not trial.get_preempt():
+            # No armed preempt flag: either a RETRIED ack whose first
+            # delivery already requeued the trial, or the evict race —
+            # the worker assigned this trial AFTER request_evict but
+            # before any flagging, so the GET path's synthetic preempted
+            # FINAL is the trial's ONLY way back into the schedule.
+            # Discriminate by where the trial is now: waiting or
+            # re-dispatched or terminal => retry, drop it; otherwise it
+            # is orphaned and must requeue (from scratch — it never ran
+            # on the evicted runner).
+            with self._store_lock:
+                waiting = trial.trial_id in self._requeue \
+                    or trial.trial_id in self._parked
+            if waiting:
+                return
+            if any(rec.get("trial_id") == trial.trial_id
+                   for rec in self.server.reservations.all().values()):
+                return
+            with trial.lock:
+                if trial.final_metric is not None \
+                        or trial.status == Trial.ERROR:
+                    return
+            msg = {**msg, "step": None}
+        step = msg.get("step")
+        trial.reset_run_state()
+        with trial.lock:
+            if step is not None:
+                trial.info_dict["resume_step"] = int(step)
+            else:
+                trial.info_dict.pop("resume_step", None)
+        with self._store_lock:
+            if trial.trial_id not in self._requeue:
+                self._requeue.append(trial.trial_id)
+        self.result["preemptions"] = self.result.get("preemptions", 0) + 1
+        self.telemetry.trial_event(trial.trial_id, "preempted",
+                                   partition=pid, step=step,
+                                   checkpointed=step is not None)
+        # The explicit re-queue edge, like LOST/BLACK paths journal: the
+        # chaos harness derives fault->requeue recovery from it.
+        self.telemetry.trial_event(trial.trial_id, "requeued",
+                                   partition=pid, reason="preempted")
+        self._log("trial {} preempted on runner {} ({}); requeued".format(
+            trial.trial_id, pid,
+            "checkpoint step {}".format(step) if step is not None
+            else "no checkpoint"))
+        if not self.server.reservations.evict_requested(pid):
+            # The runner stays with this experiment (chaos preemption, or
+            # rebalancing without eviction): hand it work now — possibly
+            # the preempted trial itself, which IS the resume path.
+            self._assign_next_locked(pid, None)
+
+    def preempt_partition(self, partition_id: int,
+                          evict: bool = False) -> Optional[str]:
+        """Gracefully preempt whatever ``partition_id`` is running:
+        arm the trial's preempt + early-stop flags so the next heartbeat
+        draws STOP(preempt) and the runner acks with a preempted FINAL
+        carrying its checkpoint step. ``evict=True`` (fleet) additionally
+        releases the runner from this experiment once the ack (or, when
+        idle, its next GET) lands. Returns the preempted trial id, or
+        None when the partition held nothing (eviction alone applies).
+        Callable from any thread — touches only trial/reservation locks."""
+        res = self.server.reservations
+        if evict:
+            res.request_evict(partition_id)
+        trial_id = res.get_assigned_trial(partition_id)
+        trial = self.get_trial(trial_id) if trial_id else None
+        if trial is None:
+            return None
+        trial.set_preempt()
+        trial.set_early_stop()
+        self.telemetry.trial_event(trial.trial_id, "preempt_requested",
+                                   partition=partition_id, evict=evict)
+        return trial.trial_id
+
     def _register_msg_callback(self, msg) -> None:
         # A respawned elastic runner arriving at its new size satisfies one
         # outstanding resize request toward that capacity.
@@ -876,7 +968,9 @@ class OptimizationDriver(Driver):
         rec = self.server.reservations.get(partition_id)
         if rec is None:
             return "live"  # REG still in flight — not evidence of death
-        if rec.get("released"):
+        if rec.get("released") or rec.get("evict"):
+            # Evicted (fleet preemption): the runner is leaving this
+            # experiment — fresh work must be rerouted, not assigned to it.
             return "released"
         bound = self.server.hb_loss_timeout
         if bound is not None and \
@@ -953,6 +1047,15 @@ class OptimizationDriver(Driver):
                 self.telemetry.trial_event(requeued.trial_id, "assigned",
                                            partition=partition_id,
                                            requeue="backlog")
+                with requeued.lock:
+                    resume_step = requeued.info_dict.get("resume_step")
+                if resume_step is not None:
+                    # Checkpoint-assisted resume: the closing edge of a
+                    # preemption (chaos invariant 7 asserts from_step
+                    # matches the preempted checkpoint step).
+                    self.telemetry.trial_event(requeued.trial_id, "resumed",
+                                               partition=partition_id,
+                                               from_step=int(resume_step))
                 return
             if last_trial is None:
                 suggestion = self._next_suggestion() if self._prefetch_enabled \

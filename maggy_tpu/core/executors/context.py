@@ -53,6 +53,15 @@ class TrialContext:
         """For a promoted ASHA/Hyperband trial: the trial it continues."""
         return self.info.get("parent")
 
+    @property
+    def resume_step(self) -> Optional[int]:
+        """For a preempted-then-requeued trial: the checkpoint step it was
+        preempted at (restore via ``restore_checkpoint`` and continue from
+        ``resume_step + 1``). None = fresh run (or it never checkpointed
+        before preemption — requeue-from-scratch)."""
+        step = self.info.get("resume_step")
+        return None if step is None else int(step)
+
     # ------------------------------------------------------- checkpointing
     def checkpointer(self):
         if self._checkpointer is None:

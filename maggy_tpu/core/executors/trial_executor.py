@@ -195,12 +195,34 @@ class TrialExecutor:
                     )
                     client.finalize_metric(metric, reporter)
                 except EarlyStopException as e:
-                    reporter.log("Trial {} early-stopped.".format(trial_id))
-                    env.dump(
-                        util.json_dumps_safe({self.optimization_key: e.metric}),
-                        trial_dir + "/.outputs.json",
-                    )
-                    client.finalize_metric(e.metric, reporter)
+                    if reporter.take_preempt():
+                        # Scheduler preemption (fleet rebalancing or a
+                        # chaos preempt_trial fault), not an early-stop
+                        # verdict: ack with the last checkpoint step so
+                        # the driver requeues the trial to RESUME there
+                        # (TrialCheckpointer layout under the trial dir;
+                        # no checkpoint -> requeue-from-scratch).
+                        from maggy_tpu.train.checkpoint import \
+                            latest_checkpoint_step
+
+                        step = latest_checkpoint_step(trial_dir)
+                        reporter.log(
+                            "Trial {} preempted{}.".format(
+                                trial_id,
+                                " at checkpoint step {}".format(step)
+                                if step is not None
+                                else " (no checkpoint; re-runs from "
+                                     "scratch)"))
+                        client.preempt_ack(trial_id, reporter, step=step)
+                    else:
+                        reporter.log(
+                            "Trial {} early-stopped.".format(trial_id))
+                        env.dump(
+                            util.json_dumps_safe(
+                                {self.optimization_key: e.metric}),
+                            trial_dir + "/.outputs.json",
+                        )
+                        client.finalize_metric(e.metric, reporter)
                 except Exception:  # noqa: BLE001 - report trial error, keep worker alive
                     reporter.log(
                         "Trial {} failed:\n{}".format(trial_id, traceback.format_exc())

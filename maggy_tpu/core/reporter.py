@@ -33,6 +33,10 @@ class Reporter:
         # and time-to-first-metric signals. None = no-op.
         self.stats = None
         self._stop_flag = False
+        # The current stop is a scheduler preemption (STOP reply carried
+        # ``preempt``): the executor acks with a preempted FINAL instead
+        # of finalizing. Consumed via take_preempt().
+        self._preempt_flag = False
         self._log_buffer: List[str] = []
         self._log_file = log_file
         self._print_tee = print_tee
@@ -175,16 +179,29 @@ class Reporter:
         return {"metric": metric, "step": step, "logs": logs,
                 "trial_id": tid, "span": span}
 
-    def early_stop(self, trial_id: Optional[str] = None) -> None:
+    def early_stop(self, trial_id: Optional[str] = None,
+                   preempt: bool = False) -> None:
         """Arm the stop flag (only once a metric exists, reference
         `reporter.py:158-161`). ``trial_id``, when given, must match the
         current trial: a STOP reply to a heartbeat that shipped the
-        PREVIOUS trial's data must not stop the trial that replaced it."""
+        PREVIOUS trial's data must not stop the trial that replaced it.
+        ``preempt`` marks the stop as a scheduler preemption."""
         with self.lock:
             if trial_id is not None and trial_id != self.trial_id:
                 return
             if self.metric is not None:
                 self._stop_flag = True
+                if preempt:
+                    self._preempt_flag = True
+
+    def take_preempt(self) -> bool:
+        """Consume the preemption marker: True exactly once per preempted
+        stop (the executor's EarlyStopException handler decides between
+        finalize and preempt-ack on it)."""
+        with self.lock:
+            flag = self._preempt_flag
+            self._preempt_flag = False
+            return flag
 
     def reset(self, trial_id: Optional[str] = None,
               span: Optional[str] = None) -> None:
@@ -192,6 +209,7 @@ class Reporter:
             self.metric = None
             self.step = None
             self._stop_flag = False
+            self._preempt_flag = False
             self._log_buffer = []
             self.trial_id = trial_id
             self.span = span

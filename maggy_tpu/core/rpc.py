@@ -110,12 +110,20 @@ class Reservations:
         self.required = required
         self.lock = threading.RLock()
         self._table: Dict[int, Dict[str, Any]] = {}
+        # Evictions requested before the partition registered (fleet
+        # preemption racing a fresh lease's REG): applied at add() so the
+        # release is delivered instead of silently lost.
+        self._pending_evict: set = set()
 
     def add(self, meta: Dict[str, Any]) -> None:
         with self.lock:
             rec = dict(meta)
             rec["last_beat"] = time.monotonic()
-            self._table[int(meta["partition_id"])] = rec
+            pid = int(meta["partition_id"])
+            if pid in self._pending_evict:
+                self._pending_evict.discard(pid)
+                rec["evict"] = True
+            self._table[pid] = rec
 
     def touch(self, partition_id) -> None:
         """Record liveness: any message from the runner counts as a beat.
@@ -255,6 +263,27 @@ class Reservations:
             if rec is not None:
                 rec["released"] = True
 
+    def request_evict(self, partition_id) -> bool:
+        """Fleet preemption: ask that this partition's runner be released
+        from the experiment (GSTOP) at its next reply opportunity — after
+        its preempted FINAL lands, or on its next GET when idle. Cleared
+        naturally when a future runner re-registers the slot (``add``
+        builds a fresh record). An unknown partition's eviction is parked
+        and applied at its registration — a fleet preemption may race the
+        fresh lease's REG, and the release must not be silently lost."""
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            if rec is None:
+                self._pending_evict.add(int(partition_id))
+                return True
+            rec["evict"] = True
+            return True
+
+    def evict_requested(self, partition_id) -> bool:
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            return bool(rec and rec.get("evict"))
+
     def all_released(self) -> bool:
         with self.lock:
             return all(rec.get("released") for rec in self._table.values())
@@ -308,6 +337,11 @@ class Server:
         self._listener: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
+        # Set when this server is published on a fleet SharedServer
+        # instead of its own listener: frames arrive through the shared
+        # event loop (routed by which experiment secret authenticates
+        # them) and stop() detaches rather than tearing a socket down.
+        self._shared: Optional["SharedServer"] = None
         self._handlers: Dict[str, Callable[[Dict[str, Any]], Dict[str, Any]]] = {}
         self._register_handlers()
 
@@ -474,26 +508,7 @@ class Server:
                         # replying — the client retries and the handler
                         # runs twice (at-least-once delivery).
                         sever_reply = True
-            handler = self._handlers.get(msg.get("type"))
-            if handler is None:
-                resp = {"type": "ERR", "error": "unknown message type"}
-            else:
-                t0 = time.monotonic()
-                try:
-                    resp = handler(msg)
-                finally:
-                    telem = self.telemetry
-                    if telem is not None:
-                        # Per-verb server-side service time, recorded even
-                        # when the handler raised — every registered verb
-                        # MUST show up as an rpc.handle_ms.<verb> histogram
-                        # after one dispatch (the conformance test pins
-                        # it). Buffer-only recording (telemetry journals
-                        # never write on this thread), so the event loop
-                        # stays I/O-free.
-                        telem.observe_ms(
-                            "rpc.handle_ms.{}".format(msg.get("type")),
-                            (time.monotonic() - t0) * 1e3)
+            resp = self.handle_message(msg)
         except (ConnectionError, socket.timeout, OSError):
             self._drop(conn)
             return
@@ -512,6 +527,28 @@ class Server:
                 conn.setblocking(False)
             except OSError:
                 pass
+
+    def handle_message(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Handler lookup + per-verb service-time timing — the transport-
+        free core of a dispatch, shared by this server's own event loop
+        and a fleet ``SharedServer`` routing frames to it. Timing is
+        recorded even when the handler raises: every registered verb MUST
+        show up as an rpc.handle_ms.<verb> histogram after one dispatch
+        (the conformance test pins it). Buffer-only recording (telemetry
+        journals never write on this thread), so event loops stay
+        I/O-free."""
+        handler = self._handlers.get(msg.get("type"))
+        if handler is None:
+            return {"type": "ERR", "error": "unknown message type"}
+        t0 = time.monotonic()
+        try:
+            return handler(msg)
+        finally:
+            telem = self.telemetry
+            if telem is not None:
+                telem.observe_ms(
+                    "rpc.handle_ms.{}".format(msg.get("type")),
+                    (time.monotonic() - t0) * 1e3)
 
     def _drop(self, conn):
         self._buffers.pop(conn, None)
@@ -558,9 +595,231 @@ class Server:
         return self.reservations.all()
 
     def stop(self):
+        if self._shared is not None:
+            # Published on a fleet's shared listener: detach this
+            # experiment's routing; the shared socket outlives it. The
+            # OWN selector was allocated in __init__ but never used —
+            # close it or a long-lived fleet host leaks one epoll fd per
+            # submitted experiment.
+            self._shared.detach(self)
+            self._shared = None
+            try:
+                self._sel.close()
+            except OSError:
+                pass
+            return
         self._stop_event.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        for key in list(self._sel.get_map().values()):
+            self._drop(key.fileobj)
+        self._sel.close()
+
+
+class SharedServer:
+    """One listening socket multiplexing MANY experiments' control
+    planes (fleet mode): each attached per-experiment ``Server`` keeps
+    its own handlers, reservations, and secret, and frames route to the
+    server whose HMAC secret authenticates them — the first authenticated
+    frame binds the connection, so steady-state verification is one HMAC
+    like a dedicated listener. Runner re-binding across experiments needs
+    no new sockets on the driver host: the runner reconnects to the SAME
+    address with the NEW experiment's secret.
+
+    The shared event loop also drives each attached server's ``_tick``
+    (heartbeat-loss scans) and the chaos engine's elapsed-time triggers,
+    exactly as a dedicated loop would.
+
+    Known trade-off: handlers run ON the shared loop, so one
+    experiment's slow handler (a FINAL fast path waiting out its bounded
+    sched-lock timeout, a chaos delay_msg) briefly head-of-line-blocks
+    the other experiments' replies — coupling a dedicated listener would
+    not have. The bound is PREFETCH_FINAL_LOCK_TIMEOUT_S (every handler
+    is otherwise buffer-only); moving dispatch onto a per-experiment
+    handler pool is the escape hatch if fleet-scale telemetry shows the
+    coupling in the hand-off gaps."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._servers: Dict[bytes, Server] = {}
+        self._conn_server: Dict[socket.socket, Server] = {}
+        self._buffers: Dict[socket.socket, bytearray] = {}
+        self._sel = selectors.DefaultSelector()
+        self._listener: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+        self.addr: Optional[Tuple[str, int]] = None
+
+    def attach(self, server: Server,
+               host: str = "127.0.0.1") -> Tuple[str, int]:
+        """Publish ``server`` on the shared listener (started lazily);
+        returns the shared (host, port)."""
+        with self._lock:
+            self._servers[server.secret] = server
+            server._shared = self
+            if self._listener is None:
+                self._start_locked(host)
+        return self.addr
+
+    def detach(self, server: Server) -> None:
+        with self._lock:
+            self._servers.pop(server.secret, None)
+            stale = [c for c, s in self._conn_server.items() if s is server]
+        for conn in stale:
+            self._drop(conn)
+
+    def _start_locked(self, host: str, port: int = 0) -> None:
+        from maggy_tpu import native
+
+        native.get_lib()  # warm the codec off the event loop (see Server)
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(128)
+        srv.setblocking(False)
+        self._listener = srv
+        self.addr = srv.getsockname()
+        self._sel.register(srv, selectors.EVENT_READ, self._accept)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rpc-shared-server")
+        self._thread.start()
+
+    def _accept(self, sock, mask):
+        conn, _ = sock.accept()
+        conn.setblocking(False)
+        self._buffers[conn] = bytearray()
+        self._sel.register(conn, selectors.EVENT_READ, self._serve)
+
+    def _serve(self, conn, mask):
+        try:
+            chunk = conn.recv(constants.RPC_RECV_BUFSIZE)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._drop(conn)
+            return
+        if not chunk:
+            self._drop(conn)
+            return
+        buf = self._buffers.get(conn)
+        if buf is None:
+            return
+        buf.extend(chunk)
+        while True:
+            extracted = self._try_extract_frame(conn, buf)
+            if extracted is None:
+                return
+            server, payload = extracted
+            self._dispatch(conn, server, payload)
+
+    def _try_extract_frame(self, conn, buf: bytearray):
+        """Pop one complete frame and resolve which experiment it belongs
+        to: a bound connection verifies against its server's secret only;
+        an unbound one tries every attached secret and binds to the first
+        match. No match = unauthenticated peer -> drop."""
+        header = 4 + 32
+        if len(buf) < header:
+            return None
+        (length,) = _LEN.unpack(bytes(buf[:4]))
+        if length > MAX_FRAME:
+            self._drop(conn)
+            return None
+        if len(buf) < header + length:
+            return None
+        mac = bytes(buf[4:header])
+        payload = bytes(buf[header:header + length])
+        with self._lock:
+            bound = self._conn_server.get(conn)
+            candidates = [bound] if bound is not None \
+                else list(self._servers.values())
+        server = next(
+            (s for s in candidates
+             if hmac.compare_digest(mac, _sign(s.secret, payload))), None)
+        if server is None:
+            self._drop(conn)
+            return None
+        if bound is None:
+            with self._lock:
+                self._conn_server[conn] = server
+        del buf[:header + length]
+        return server, payload
+
+    def _dispatch(self, conn, server: Server, payload: bytes):
+        """Mirror of ``Server._dispatch`` with the target server resolved
+        per frame: same chaos hooks, same error wrapping, reply signed
+        with THAT experiment's secret."""
+        sever_reply = False
+        try:
+            msg = msgpack.unpackb(payload, raw=False, strict_map_key=False)
+            engine = chaos_engine()
+            if engine is not None:
+                action = engine.on_server_message(msg)
+                if action is not None:
+                    if action[0] == "drop":
+                        self._drop(conn)
+                        return
+                    if action[0] == "delay":
+                        time.sleep(action[1])
+                    elif action[0] == "sever":
+                        sever_reply = True
+            resp = server.handle_message(msg)
+        except (ConnectionError, socket.timeout, OSError):
+            self._drop(conn)
+            return
+        except Exception as e:  # noqa: BLE001 - a bad message must never kill the loop
+            resp = {"type": "ERR", "error": "handler error: {!r}".format(e)}
+        if sever_reply:
+            self._drop(conn)
+            return
+        try:
+            conn.setblocking(True)
+            MessageSocket.send_msg(conn, resp, server.secret)
+        except OSError:
+            self._drop(conn)
+        finally:
+            try:
+                conn.setblocking(False)
+            except OSError:
+                pass
+
+    def _drop(self, conn):
+        self._buffers.pop(conn, None)
+        with self._lock:
+            self._conn_server.pop(conn, None)
+        try:
+            self._sel.unregister(conn)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _loop(self):
+        while not self._stop_event.is_set():
+            events = self._sel.select(timeout=0.2)
+            for key, mask in events:
+                key.data(key.fileobj, mask)
+            with self._lock:
+                servers = list(self._servers.values())
+            for server in servers:
+                try:
+                    server._tick()
+                except Exception:  # noqa: BLE001 - one experiment's tick must not kill the loop
+                    pass
+            engine = chaos_engine()
+            if engine is not None:
+                engine.tick()
+
+    def stop(self):
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        with self._lock:
+            servers = list(self._servers.values())
+            self._servers.clear()
+        for server in servers:
+            server._shared = None
         for key in list(self._sel.get_map().values()):
             self._drop(key.fileobj)
         self._sel.close()
@@ -673,7 +932,11 @@ class OptimizationServer(Server):
             if telem is not None:
                 telem.trial_event(trial_id, "stop_sent", once=True,
                                   partition=int(msg["partition_id"]))
-            return {"type": "STOP", "span": msg.get("span")}
+            # ``preempt``: this stop is a scheduler preemption, not an
+            # early-stop verdict — the runner acks with a preempted FINAL
+            # (carrying its last checkpoint step) instead of finalizing.
+            return {"type": "STOP", "span": msg.get("span"),
+                    "preempt": bool(trial and trial.get_preempt())}
         return {"type": "OK"}
 
     def _final(self, msg):
@@ -693,6 +956,14 @@ class OptimizationServer(Server):
         fast = getattr(self.driver, "process_final_inline", None)
         if fast is None or not fast(msg):
             self.driver.enqueue(dict(msg))
+            if self.reservations.evict_requested(msg["partition_id"]) and \
+                    msg.get("preempted"):
+                # Worker-path preempt ack of an evicted runner: release it
+                # now — the enqueued message only requeues the trial, and
+                # the runner must not GET-poll an experiment it has been
+                # preempted out of.
+                self.reservations.mark_released(msg["partition_id"])
+                return {"type": "GSTOP"}
             return {"type": "OK"}
         pid = msg["partition_id"]
         telem = self.telemetry
@@ -705,14 +976,21 @@ class OptimizationServer(Server):
                 telem.trial_event(reply["trial_id"], "prefetch_hit",
                                   once=True, partition=int(pid))
             return reply
+        if self.reservations.evict_requested(pid):
+            # Fleet preemption: the runner's ack doubles as its release —
+            # it re-binds to another experiment, not to this one's GET.
+            self.reservations.mark_released(pid)
+            return {"type": "GSTOP"}
         if self.driver.experiment_done:
             # Inline release: the runner's last FINAL doubles as its GSTOP.
             self.reservations.mark_released(pid)
             return {"type": "GSTOP"}
-        if telem is not None:
+        if telem is not None and not msg.get("preempted"):
             # Nothing ready (controller IDLE / rung barrier / expensive
             # suggest still fitting): the runner falls back to GET.
-            # once=True matches the hit side under retried FINALs.
+            # once=True matches the hit side under retried FINALs. A
+            # preempted ack is not a hand-off attempt — it must not count
+            # as a pipeline miss.
             telem.trial_event(msg.get("trial_id"), "prefetch_miss",
                               once=True, partition=int(pid))
         return {"type": "OK"}
@@ -747,6 +1025,20 @@ class OptimizationServer(Server):
 
     def _get(self, msg):
         self.reservations.touch(msg["partition_id"])
+        pid = msg["partition_id"]
+        if self.reservations.evict_requested(pid):
+            # Fleet preemption of an idle (or between-trials) runner: hand
+            # any undelivered assignment back to the schedule as a
+            # never-started preemption (requeue-from-scratch) and release
+            # the runner so it can re-bind to another experiment.
+            tid = self.reservations.get_assigned_trial(pid)
+            if tid is not None:
+                self.reservations.clear_trial_if(pid, tid)
+                self.driver.enqueue({"type": "FINAL", "trial_id": tid,
+                                     "partition_id": pid, "preempted": True,
+                                     "step": None, "logs": []})
+            self.reservations.mark_released(pid)
+            return {"type": "GSTOP"}
         # Serve an already-assigned trial BEFORE honoring experiment-done:
         # the last suggestion may be assigned concurrently with another
         # FINAL ending the experiment, and must still run.
@@ -1034,8 +1326,12 @@ class Client:
                     if resp.get("type") == "STOP":
                         # Only stop the trial the beat was ABOUT: the
                         # runner may have rolled over to the next trial
-                        # while this beat was in flight.
-                        reporter.early_stop(trial_id=sent_tid)
+                        # while this beat was in flight. ``preempt``
+                        # marks a scheduler preemption (ack with a
+                        # preempted FINAL, not a finalize).
+                        reporter.early_stop(trial_id=sent_tid,
+                                            preempt=bool(
+                                                resp.get("preempt")))
                 except ConnectionError:
                     if stats is not None and delta:
                         # The ship failed — put the delta back so the next
@@ -1160,6 +1456,26 @@ class Client:
                 {"type": "FINAL", "trial_id": trial_id, "value": None,
                  "error": True, "logs": data["logs"],
                  "span": data.get("span")}
+            )
+            reporter.reset()
+        self._handle_final_reply(resp)
+        return resp
+
+    def preempt_ack(self, trial_id: str, reporter,
+                    step: Optional[int] = None) -> Dict[str, Any]:
+        """Acknowledge a scheduler preemption: FINAL flagged ``preempted``
+        with the trial's last checkpoint ``step`` (None = it never
+        checkpointed; the driver requeues from scratch). Routed through
+        the same reply handling as finalize_metric so an evicted runner's
+        GSTOP — or a surviving runner's piggybacked next assignment —
+        lands the same way."""
+        with reporter.lock:
+            data = reporter.get_data()
+            resp = self._request(
+                {"type": "FINAL", "trial_id": trial_id, "value": None,
+                 "preempted": True,
+                 "step": int(step) if step is not None else None,
+                 "logs": data["logs"], "span": data.get("span")}
             )
             reporter.reset()
         self._handle_final_reply(resp)

@@ -1,0 +1,30 @@
+"""Shared-fleet scheduling: multiplex concurrent experiments over one
+persistent runner fleet (see scheduler.py for the full design).
+
+    from maggy_tpu.fleet import Fleet
+    from maggy_tpu import experiment
+
+    with Fleet(runners=8) as fleet:
+        a = experiment.lagom_submit(train_a, cfg_a, fleet=fleet,
+                                    weight=2.0, block=False)
+        b = experiment.lagom_submit(train_b, cfg_b, fleet=fleet,
+                                    priority="high", min_runners=2,
+                                    block=False)
+        results = a.result(), b.result()
+
+CLI: ``python -m maggy_tpu.fleet start|submit|status`` (spool-file
+submissions for cross-process use); live view:
+``python -m maggy_tpu.monitor --fleet <home_dir>``.
+"""
+
+from maggy_tpu.fleet.scheduler import (FLEET_JOURNAL_NAME, ExperimentEntry,
+                                       Fleet, FleetBinding, FleetLeasedPool,
+                                       FleetPolicy, FleetScheduler,
+                                       FleetSubmission, priority_rank,
+                                       replay_fleet_journal)
+
+__all__ = [
+    "Fleet", "FleetPolicy", "FleetScheduler", "FleetBinding",
+    "FleetLeasedPool", "FleetSubmission", "ExperimentEntry",
+    "FLEET_JOURNAL_NAME", "priority_rank", "replay_fleet_journal",
+]

@@ -1,0 +1,936 @@
+"""Shared-fleet scheduler: multiplex concurrent experiments over one
+persistent runner fleet.
+
+The classic ``lagom()`` path owns its runner pool for the lifetime of one
+experiment and tears it down afterwards — a v4-32 pod serving many users
+sits idle between sweeps. Fleet mode inverts the ownership (the Podracer
+shape, arXiv:2104.06272): a ``Fleet`` holds a long-lived pool of runner
+loops, and a ``FleetScheduler`` leases them to whichever submitted
+experiments deserve them under
+
+- **priority classes** (``high``/``normal``/``low`` or any int; lower rank
+  wins) — capacity is granted strictly by class when computing targets;
+- **weighted fair share** — within the capacity a class receives, runners
+  are split proportionally to each experiment's ``weight`` (largest
+  remainder), and lease-time accounting (virtual time = runner-seconds /
+  weight) breaks ties so long-run shares track the weights even when
+  allocation is lumpy;
+- **per-experiment quotas** — ``min_runners`` is satisfied first (in
+  priority order), ``max_runners`` caps what fair share may grant;
+- an **admission queue** — at most ``max_active`` experiments compete at
+  once; the rest wait in (priority, submit-order) line;
+- **preemption** — an experiment below its guaranteed allocation for
+  longer than ``preempt_grace_s`` triggers a *graceful* preemption of the
+  most-over-share victim: the victim driver flags the trial through the
+  existing early-stop machinery (the STOP reply carries ``preempt``), the
+  runner acks with a preempted FINAL carrying its last checkpoint step
+  (``train/checkpoint.py`` layout), the driver requeues the trial so it
+  *resumes from that step* on its next runner (requeue-from-scratch when
+  it never checkpointed), and the freed runner re-binds to the starving
+  experiment.
+
+Runners are re-bindable: one fleet runner executes experiment A's trial
+executor until released (GSTOP or eviction), then asks the scheduler for
+its next binding and re-registers against experiment B's server with B's
+secret and executor config. Per-experiment control-plane traffic shares
+ONE listening socket (``core.rpc.SharedServer``), routed by which
+experiment's HMAC secret authenticates the frame.
+
+Everything the scheduler decides is journaled to ``fleet.jsonl``
+(``lease`` start/end, ``preempt``, admission, lifecycle), so shares,
+queue waits, and preemption counts are replayable offline
+(``replay_fleet_journal``) and renderable as per-experiment lanes on each
+runner track (``python -m maggy_tpu.telemetry trace <fleet_home>``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from maggy_tpu.core.runner_pool import RunnerPool, ThreadRunnerPool
+
+#: Fleet journal filename inside the fleet home dir.
+FLEET_JOURNAL_NAME = "fleet.jsonl"
+
+#: Named priority classes (lower rank = served first). Ints pass through.
+PRIORITY_CLASSES = {"high": 0, "normal": 1, "low": 2}
+
+
+def priority_rank(priority) -> int:
+    if isinstance(priority, str):
+        try:
+            return PRIORITY_CLASSES[priority.lower()]
+        except KeyError:
+            raise ValueError(
+                "Unknown priority {!r}; use one of {} or an int".format(
+                    priority, sorted(PRIORITY_CLASSES)))
+    if isinstance(priority, bool) or not isinstance(priority, int):
+        raise ValueError("priority must be a class name or int, got "
+                         "{!r}".format(priority))
+    return priority
+
+
+class FleetPolicy:
+    """Scheduling policy of one submission: priority class, fair-share
+    weight, and the min/max runner quota."""
+
+    __slots__ = ("priority", "weight", "min_runners", "max_runners")
+
+    def __init__(self, priority="normal", weight: float = 1.0,
+                 min_runners: int = 0, max_runners: Optional[int] = None):
+        priority_rank(priority)  # validate early
+        self.priority = priority
+        self.weight = float(weight)
+        if self.weight <= 0:
+            raise ValueError("weight must be > 0, got {}".format(weight))
+        self.min_runners = int(min_runners)
+        if self.min_runners < 0:
+            raise ValueError("min_runners must be >= 0")
+        self.max_runners = None if max_runners is None else int(max_runners)
+        if self.max_runners is not None and self.max_runners < 1:
+            raise ValueError("max_runners must be >= 1 (or None)")
+        if self.max_runners is not None \
+                and self.min_runners > self.max_runners:
+            raise ValueError("min_runners {} exceeds max_runners {}".format(
+                self.min_runners, self.max_runners))
+
+    @property
+    def rank(self) -> int:
+        return priority_rank(self.priority)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"priority": self.priority, "weight": self.weight,
+                "min_runners": self.min_runners,
+                "max_runners": self.max_runners}
+
+
+class ExperimentEntry:
+    """One submitted experiment's scheduling state. All mutable fields are
+    guarded by the scheduler's lock."""
+
+    def __init__(self, name: str, policy: FleetPolicy, seq: int):
+        self.name = name
+        self.policy = policy
+        self.seq = seq
+        self.state = "queued"  # queued -> active -> done | failed
+        self.submitted_t = time.time()
+        self.admitted_t: Optional[float] = None
+        self.first_lease_t: Optional[float] = None
+        # Bound at activate() (the driver exists by then):
+        self.driver = None
+        self.executor_fn: Optional[Callable[[int], None]] = None
+        self.slots = 0
+        self.free_pids: set = set()
+        self.exp_dir: Optional[str] = None
+        # Lease accounting.
+        self.open_leases: Dict[int, Tuple[int, float]] = {}  # runner -> (pid, t0)
+        self.service_s = 0.0
+        self.lease_count = 0
+        self.preemptions = 0          # suffered
+        self.preempting_pids: set = set()
+        self.failures: List[BaseException] = []
+        self.deficit_since: Optional[float] = None
+
+    # -- read helpers (scheduler lock held) --------------------------------
+
+    def allocated(self) -> int:
+        return len(self.open_leases)
+
+    def effective_max(self, fleet_size: int) -> int:
+        cap = fleet_size
+        if self.policy.max_runners is not None:
+            cap = min(cap, self.policy.max_runners)
+        if self.slots:
+            cap = min(cap, self.slots)
+        return cap
+
+    def vtime(self, now: float) -> float:
+        live = sum(now - t0 for _, t0 in self.open_leases.values())
+        return (self.service_s + live) / self.policy.weight
+
+    def ready(self) -> bool:
+        return self.state == "active" and self.executor_fn is not None
+
+    def wants_runners(self) -> bool:
+        if not self.ready() or not self.free_pids:
+            return False
+        drv = self.driver
+        return not (drv is not None and drv.experiment_done)
+
+    def snapshot(self) -> Dict[str, Any]:
+        qw = None
+        if self.first_lease_t is not None:
+            qw = round(self.first_lease_t - self.submitted_t, 3)
+        return {"name": self.name, "state": self.state,
+                **self.policy.to_dict(),
+                "allocated": self.allocated(), "leases": self.lease_count,
+                "service_s": round(self.service_s, 3),
+                "preemptions": self.preemptions,
+                "queue_wait_s": qw, "failures": len(self.failures),
+                "exp_dir": self.exp_dir}
+
+
+class FleetScheduler:
+    """Decides which experiment each free fleet runner serves next, and
+    when a running one must give a runner up. Pure in-process state; every
+    decision is journaled through the fleet's telemetry."""
+
+    def __init__(self, fleet_size: int, telemetry=None,
+                 max_active: Optional[int] = None,
+                 preempt_grace_s: float = 1.0):
+        self.fleet_size = int(fleet_size)
+        self.telemetry = telemetry
+        self.max_active = max_active
+        self.preempt_grace_s = float(preempt_grace_s)
+        self._lock = threading.RLock()
+        self._wake = threading.Condition(self._lock)
+        self._entries: Dict[str, ExperimentEntry] = {}
+        # Final snapshots of completed experiments (bounded): finished
+        # entries leave _entries so scheduling decisions stay O(live)
+        # and a long-lived fleet host doesn't grow without bound.
+        self._finished: List[Dict[str, Any]] = []
+        self._seq = itertools.count()
+        self.stopped = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def submit(self, name: str, policy: FleetPolicy) -> ExperimentEntry:
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(
+                    "experiment {!r} is already submitted to this "
+                    "fleet".format(name))
+            entry = ExperimentEntry(name, policy, next(self._seq))
+            self._entries[name] = entry
+            self._event("fleet_submit", exp=name, **policy.to_dict())
+            self._admit_locked()
+            self._wake.notify_all()
+        return entry
+
+    def _admit_locked(self) -> None:
+        active = sum(1 for e in self._entries.values()
+                     if e.state == "active")
+        queued = sorted((e for e in self._entries.values()
+                         if e.state == "queued"),
+                        key=lambda e: (e.policy.rank, e.seq))
+        for entry in queued:
+            if self.max_active is not None and active >= self.max_active:
+                break
+            entry.state = "active"
+            entry.admitted_t = time.time()
+            active += 1
+            self._event("fleet_admit", exp=entry.name,
+                        queued_s=round(entry.admitted_t
+                                       - entry.submitted_t, 3))
+
+    def activate(self, entry: ExperimentEntry, driver,
+                 executor_fn: Callable[[int], None], slots: int) -> None:
+        """The experiment's driver is up: bind it so leasing can begin.
+        ``slots`` is the driver's partition-id range (its server's
+        num_executors)."""
+        with self._lock:
+            entry.driver = driver
+            entry.executor_fn = executor_fn
+            entry.slots = int(slots)
+            entry.free_pids = set(range(int(slots)))
+            entry.exp_dir = getattr(driver, "exp_dir", None)
+            self._event("fleet_experiment", exp=entry.name, phase="start",
+                        slots=entry.slots, exp_dir=entry.exp_dir)
+            self._wake.notify_all()
+
+    def finish(self, entry: ExperimentEntry, state: str = "done") -> None:
+        with self._lock:
+            if entry.state in ("done", "failed"):
+                return
+            entry.state = state
+            self._event("fleet_experiment", exp=entry.name, phase=state)
+            # Retire the entry: late release_binding calls still work on
+            # the object itself; only the scheduling/status sets forget
+            # it. Keep a bounded tail of final snapshots for status.json.
+            self._entries.pop(entry.name, None)
+            self._finished.append(entry.snapshot())
+            del self._finished[:-100]
+            self._admit_locked()
+            self._wake.notify_all()
+
+    def stop(self) -> None:
+        with self._lock:
+            self.stopped = True
+            self._wake.notify_all()
+
+    # -------------------------------------------------------------- targets
+
+    def _targets_locked(self) -> Dict[str, int]:
+        """Per-experiment runner target: min_runners first in priority
+        order, then leftover capacity waterfilled class by class with a
+        weighted largest-remainder split, clamped to each experiment's
+        effective max. This is the allocation both binding and preemption
+        steer toward."""
+        active = [e for e in self._entries.values()
+                  if e.ready() and not (e.driver is not None
+                                        and e.driver.experiment_done)]
+        targets = {e.name: 0 for e in active}
+        remaining = self.fleet_size
+        # Guaranteed minimums, strictly by priority then submit order.
+        for e in sorted(active, key=lambda e: (e.policy.rank, e.seq)):
+            give = min(e.policy.min_runners, e.effective_max(self.fleet_size),
+                       remaining)
+            targets[e.name] = give
+            remaining -= give
+        # Leftovers: class by class, weighted largest remainder.
+        by_rank: Dict[int, List[ExperimentEntry]] = {}
+        for e in active:
+            by_rank.setdefault(e.policy.rank, []).append(e)
+        for rank in sorted(by_rank):
+            if remaining <= 0:
+                break
+            members = by_rank[rank]
+            while remaining > 0:
+                head = [e for e in members
+                        if targets[e.name] < e.effective_max(self.fleet_size)]
+                if not head:
+                    break
+                wsum = sum(e.policy.weight for e in head)
+                grant = {}
+                for e in head:
+                    grant[e.name] = remaining * e.policy.weight / wsum
+                floors = {n: int(g) for n, g in grant.items()}
+                used = 0
+                for e in head:
+                    room = e.effective_max(self.fleet_size) - targets[e.name]
+                    add = min(floors[e.name], room)
+                    targets[e.name] += add
+                    used += add
+                if used == 0:
+                    # All floors were zero: hand single runners out by
+                    # largest fractional remainder until spent.
+                    order = sorted(
+                        head, key=lambda e: (-(grant[e.name]
+                                               - floors[e.name]), e.seq))
+                    for e in order:
+                        if remaining - used <= 0:
+                            break
+                        if targets[e.name] < e.effective_max(self.fleet_size):
+                            targets[e.name] += 1
+                            used += 1
+                if used == 0:
+                    break
+                remaining -= used
+        return targets
+
+    # -------------------------------------------------------------- binding
+
+    def next_binding(self, runner_idx: int,
+                     timeout: Optional[float] = None
+                     ) -> Optional[Tuple[ExperimentEntry, int]]:
+        """Block until an experiment deserves this runner; returns
+        ``(entry, partition_id)`` or None when the fleet is shutting down
+        (or ``timeout`` elapsed)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if self.stopped:
+                    return None
+                picked = self._pick_locked()
+                if picked is not None:
+                    return self._lease_locked(runner_idx, picked)
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None
+                self._wake.wait(timeout=0.2)
+
+    def _pick_locked(self) -> Optional[ExperimentEntry]:
+        targets = self._targets_locked()
+        now = time.monotonic()
+        best = None
+        best_key = None
+        for e in self._entries.values():
+            if not e.wants_runners():
+                continue
+            if e.allocated() >= e.effective_max(self.fleet_size):
+                continue
+            key = (e.allocated() - targets.get(e.name, 0),
+                   e.policy.rank, e.vtime(now), e.seq)
+            if best_key is None or key < best_key:
+                best, best_key = e, key
+        return best
+
+    def _lease_locked(self, runner_idx: int,
+                      entry: ExperimentEntry) -> Tuple[ExperimentEntry, int]:
+        pid = min(entry.free_pids)
+        entry.free_pids.discard(pid)
+        entry.open_leases[runner_idx] = (pid, time.monotonic())
+        entry.lease_count += 1
+        entry.deficit_since = None
+        if entry.first_lease_t is None:
+            entry.first_lease_t = time.time()
+        self._event("lease", exp=entry.name, runner=runner_idx, pid=pid,
+                    phase="start", exp_dir=entry.exp_dir)
+        return entry, pid
+
+    def release_binding(self, runner_idx: int, entry: ExperimentEntry,
+                        pid: int, error: Optional[BaseException] = None
+                        ) -> None:
+        with self._lock:
+            held = entry.open_leases.pop(runner_idx, None)
+            if held is not None:
+                entry.service_s += time.monotonic() - held[1]
+            entry.free_pids.add(pid)
+            entry.preempting_pids.discard(pid)
+            if error is not None:
+                entry.failures.append(error)
+            self._event("lease", exp=entry.name, runner=runner_idx, pid=pid,
+                        phase="end",
+                        reason="error" if error is not None else "released",
+                        duration_s=round(time.monotonic() - held[1], 3)
+                        if held is not None else None)
+            self._wake.notify_all()
+
+    def runner_for(self, entry: ExperimentEntry,
+                   pid: int) -> Optional[int]:
+        with self._lock:
+            for runner, (p, _t0) in entry.open_leases.items():
+                if p == pid:
+                    return runner
+        return None
+
+    # ----------------------------------------------------------- preemption
+
+    def maybe_preempt(self) -> int:
+        """One preemption sweep: every experiment below its guaranteed
+        allocation (``max(1, min_runners)`` capped by its target/max) for
+        longer than ``preempt_grace_s`` gets ONE runner carved out of the
+        most-over-share victim. Returns the number of preemptions
+        initiated. Driver calls happen outside the scheduler lock."""
+        actions: List[Tuple[ExperimentEntry, ExperimentEntry, int]] = []
+        now = time.monotonic()
+        with self._lock:
+            if self.stopped:
+                return 0
+            targets = self._targets_locked()
+            for e in self._entries.values():
+                if not e.wants_runners():
+                    e.deficit_since = None
+                    continue
+                want = max(1, min(e.policy.min_runners,
+                                  e.effective_max(self.fleet_size)),
+                           targets.get(e.name, 0))
+                want = min(want, e.effective_max(self.fleet_size))
+                if e.allocated() >= want:
+                    e.deficit_since = None
+                    continue
+                if e.deficit_since is None:
+                    e.deficit_since = now
+                    continue
+                if now - e.deficit_since < self.preempt_grace_s:
+                    continue
+                victim = self._victim_locked(e, targets)
+                if victim is None:
+                    continue
+                runner, (pid, _t0) = max(victim.open_leases.items(),
+                                         key=lambda kv: kv[1][1])
+                if pid in victim.preempting_pids:
+                    continue
+                victim.preempting_pids.add(pid)
+                e.deficit_since = now  # re-arm: one preemption per grace
+                actions.append((victim, e, pid))
+        fired = 0
+        for victim, starving, pid in actions:
+            trial = None
+            ok = True
+            try:
+                trial = victim.driver.preempt_partition(pid, evict=True)
+            except Exception:  # noqa: BLE001 - a failed preempt must not kill the tick
+                ok = False
+            with self._lock:
+                if not ok:
+                    # Nothing was delivered: un-throttle the pid so a
+                    # later sweep can retry, and don't count/journal a
+                    # preemption that never happened.
+                    victim.preempting_pids.discard(pid)
+                    continue
+                victim.preemptions += 1
+            fired += 1
+            # trial=None marks an idle eviction (the runner was between
+            # trials — released without any work lost).
+            self._event("preempt", exp=victim.name, pid=pid,
+                        runner=self.runner_for(victim, pid),
+                        trial=trial, for_exp=starving.name)
+        return fired
+
+    def _victim_locked(self, starving: ExperimentEntry,
+                       targets: Dict[str, int]
+                       ) -> Optional[ExperimentEntry]:
+        now = time.monotonic()
+        candidates = []
+        for v in self._entries.values():
+            if v is starving or v.state != "active" or not v.open_leases:
+                continue
+            if v.allocated() - 1 < min(v.policy.min_runners,
+                                       v.effective_max(self.fleet_size)):
+                continue
+            over_share = v.allocated() > targets.get(v.name, 0)
+            lower_class = v.policy.rank > starving.policy.rank
+            # Rotation: with more same-class experiments than runners,
+            # everyone sits exactly AT target (ties broken by submit
+            # order) and leases last whole experiments — without this, a
+            # runner-less peer would starve until someone finished.
+            # Preempting the peer with the most weighted service hands
+            # the fleet around in virtual-time order, so the starvation
+            # bound is the grace period plus one service-differential.
+            rotation = (starving.allocated() == 0
+                        and v.policy.rank == starving.policy.rank
+                        and v.vtime(now) > starving.vtime(now))
+            if not (over_share or lower_class or rotation):
+                continue
+            candidates.append(v)
+        if not candidates:
+            return None
+        return max(candidates,
+                   key=lambda v: (v.policy.rank,
+                                  v.allocated() - targets.get(v.name, 0),
+                                  v.vtime(now)))
+
+    # ------------------------------------------------------------- querying
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            entries = sorted(self._entries.values(), key=lambda e: e.seq)
+            return {
+                "fleet_size": self.fleet_size,
+                "queue_depth": sum(1 for e in entries
+                                   if e.state == "queued"),
+                "active": sum(1 for e in entries if e.state == "active"),
+                "experiments": list(self._finished)
+                + [e.snapshot() for e in entries],
+            }
+
+    def _event(self, ev: str, **fields: Any) -> None:
+        telem = self.telemetry
+        if telem is not None:
+            telem.event(ev, **fields)
+
+
+class FleetSubmission:
+    """Handle for one ``Fleet.submit``: blocks on ``result()``."""
+
+    def __init__(self, name: str, entry: ExperimentEntry):
+        self.name = name
+        self.entry = entry
+        self._done = threading.Event()
+        self._result: Any = None
+        self._exc: Optional[BaseException] = None
+
+    def _set_result(self, result: Any) -> None:
+        self._result = result
+        self._done.set()
+
+    def _set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(
+                "experiment {!r} did not finish within {}s".format(
+                    self.name, timeout))
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class FleetBinding:
+    """What ``config.fleet`` carries for a fleet-attached experiment: the
+    fleet handle plus this experiment's scheduler entry. The driver uses
+    it to (a) publish its RPC server on the fleet's shared listener and
+    (b) lease runners instead of owning a pool."""
+
+    def __init__(self, fleet: "Fleet", entry: ExperimentEntry):
+        self.fleet = fleet
+        self.entry = entry
+
+    def attach_server(self, server) -> Tuple[str, int]:
+        return self.fleet.shared_server.attach(server)
+
+    def lease_pool(self, driver) -> "FleetLeasedPool":
+        return FleetLeasedPool(self, driver)
+
+
+class FleetLeasedPool(RunnerPool):
+    """The driver-facing pool adapter in fleet mode: ``run`` registers the
+    experiment's executor with the scheduler and waits for completion —
+    the fleet's runner loops are the actual substrate (the same shape as
+    ``RemoteRunnerPool``, with the scheduler standing in for the join
+    ticket)."""
+
+    #: A fleet runner that keeps dying inside this experiment's executor
+    #: is quarantined after this many failures per slot — without a cap a
+    #: pathological executor would rebind-and-crash forever.
+    MAX_FAILURES_PER_SLOT = 3
+
+    def __init__(self, binding: FleetBinding, driver):
+        super().__init__(driver.num_executors)
+        self.binding = binding
+        self.driver = driver
+
+    def run(self, worker_fn: Callable[[int], None]) -> List[BaseException]:
+        fleet = self.binding.fleet
+        entry = self.binding.entry
+        scheduler = fleet.scheduler
+        scheduler.activate(entry, self.driver, worker_fn,
+                           slots=self.num_workers)
+        cap = self.MAX_FAILURES_PER_SLOT * max(1, self.num_workers)
+        while not self.driver.experiment_done:
+            if scheduler.stopped:
+                return [RuntimeError(
+                    "fleet shut down while experiment {!r} was "
+                    "running".format(entry.name))]
+            with scheduler._lock:
+                n_failures = len(entry.failures)
+            if n_failures > cap:
+                return list(entry.failures)
+            time.sleep(0.05)
+        # Let leased runners observe their GSTOP before the driver tears
+        # the server down (mirrors RemoteRunnerPool's release-ack grace).
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with scheduler._lock:
+                if not entry.open_leases:
+                    break
+            time.sleep(0.05)
+        return list(entry.failures)
+
+    def kill_worker(self, partition_id: int) -> bool:
+        runner = self.binding.fleet.scheduler.runner_for(
+            self.binding.entry, partition_id)
+        if runner is None:
+            return False
+        return self.binding.fleet.pool.kill_worker(runner)
+
+    def terminate(self) -> None:
+        # The fleet owns its runners; a doomed experiment must not take
+        # the shared substrate down with it.
+        pass
+
+
+class Fleet:
+    """A persistent, shared runner fleet plus its scheduler, shared RPC
+    listener, and journal. In-process: submissions are train-fn callables,
+    so the fleet and its experiments live in one Python process (threads);
+    the ``python -m maggy_tpu.fleet`` CLI hosts one for spool-file
+    submissions from other processes."""
+
+    def __init__(self, runners: int = 2, *, pool: str = "thread",
+                 name: str = "fleet", home_dir: Optional[str] = None,
+                 env=None, max_active: Optional[int] = None,
+                 preempt_grace_s: float = 1.0, telemetry: bool = True):
+        if pool != "thread":
+            raise ValueError(
+                "fleet pools are in-process ('thread'): experiments are "
+                "submitted as live callables and scheduler bindings cross "
+                "no process boundary (got pool={!r})".format(pool))
+        from maggy_tpu.core.environment import EnvSing
+        from maggy_tpu.core.rpc import SharedServer
+        from maggy_tpu.telemetry import Telemetry
+
+        self.name = name
+        self.env = env or EnvSing.get_instance()
+        self.num_runners = int(runners)
+        self.pool = ThreadRunnerPool(self.num_runners)
+        self.home_dir = home_dir or os.path.join(
+            self.env.experiment_base_dir(), "fleets", name)
+        self.telemetry = Telemetry(
+            env=self.env,
+            journal_path=self.home_dir + "/" + FLEET_JOURNAL_NAME,
+            enabled=telemetry)
+        self.scheduler = FleetScheduler(
+            self.num_runners, telemetry=self.telemetry,
+            max_active=max_active, preempt_grace_s=preempt_grace_s)
+        self.shared_server = SharedServer()
+        self._pool_thread: Optional[threading.Thread] = None
+        self._tick_thread: Optional[threading.Thread] = None
+        self._started = False
+        self._stopped = False
+        self._lock = threading.Lock()
+        self._submissions: Dict[str, FleetSubmission] = {}
+        self._sub_threads: List[threading.Thread] = []
+        self._sub_seq = itertools.count()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "Fleet":
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+        self.telemetry.event("fleet", phase="start", name=self.name,
+                             runners=self.num_runners, pool="thread")
+        self._pool_thread = threading.Thread(
+            target=self.pool.run, args=(self._runner_loop,),
+            daemon=True, name="fleet-pool")
+        self._pool_thread.start()
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, daemon=True, name="fleet-tick")
+        self._tick_thread.start()
+        self._dump_status()
+        return self
+
+    def _runner_loop(self, runner_idx: int) -> None:
+        """One persistent fleet runner: bind -> run the experiment's
+        executor until released -> re-bind. An executor exception (e.g. a
+        dead control plane) is a lease failure, not a fleet failure — the
+        runner survives and re-binds."""
+        while True:
+            binding = self.scheduler.next_binding(runner_idx)
+            if binding is None:
+                return
+            entry, pid = binding
+            err: Optional[BaseException] = None
+            try:
+                entry.executor_fn(pid)
+            except BaseException as e:  # noqa: BLE001 - lease failure, runner survives
+                err = RuntimeError(
+                    "fleet runner {} failed in experiment {!r} (partition "
+                    "{}): {!r}".format(runner_idx, entry.name, pid, e))
+            finally:
+                self.scheduler.release_binding(runner_idx, entry, pid,
+                                               error=err)
+
+    def _tick_loop(self) -> None:
+        last_status = 0.0
+        while not self.scheduler.stopped:
+            self.scheduler.maybe_preempt()
+            now = time.monotonic()
+            if now - last_status >= 0.5:
+                last_status = now
+                self._dump_status()
+            time.sleep(0.1)
+
+    def shutdown(self, wait: bool = True, timeout: float = 30.0) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        if wait:
+            deadline = time.monotonic() + timeout
+            for t in list(self._sub_threads):
+                t.join(timeout=max(0.1, deadline - time.monotonic()))
+        self.scheduler.stop()
+        for t in (self._pool_thread, self._tick_thread):
+            if t is not None:
+                t.join(timeout=5)
+        self.shared_server.stop()
+        self.telemetry.event("fleet", phase="stop")
+        self._dump_status()
+        self.telemetry.close()
+
+    def __enter__(self) -> "Fleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # ------------------------------------------------------------ submission
+
+    def submit(self, train_fn: Callable, config, *, priority="normal",
+               weight: float = 1.0, min_runners: int = 0,
+               max_runners: Optional[int] = None,
+               name: Optional[str] = None) -> FleetSubmission:
+        """Queue one experiment onto the fleet; returns a handle whose
+        ``result()`` blocks for the experiment's result (the same value
+        ``lagom`` would return)."""
+        self.start()
+        policy = FleetPolicy(priority=priority, weight=weight,
+                             min_runners=min_runners,
+                             max_runners=max_runners)
+        base = name or getattr(config, "name", "experiment")
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("fleet {!r} is shut down".format(self.name))
+            sub_name = base
+            while sub_name in self._submissions:
+                sub_name = "{}-{}".format(base, next(self._sub_seq))
+            entry = self.scheduler.submit(sub_name, policy)
+            handle = FleetSubmission(sub_name, entry)
+            self._submissions[sub_name] = handle
+            # Prune finished submission threads so a long-lived host
+            # doesn't accumulate one dead Thread per spool submission.
+            self._sub_threads = [t for t in self._sub_threads
+                                 if t.is_alive()]
+            thread = threading.Thread(
+                target=self._run_submission,
+                args=(handle, train_fn, config),
+                daemon=True, name="fleet-exp-{}".format(sub_name))
+            self._sub_threads.append(thread)
+        thread.start()
+        return handle
+
+    def _run_submission(self, handle: FleetSubmission, train_fn: Callable,
+                        config) -> None:
+        """Submission thread: claim a run id, build the driver with the
+        fleet binding in its config, and run the experiment — the driver's
+        pool is a ``FleetLeasedPool``, so all its runners come from the
+        shared fleet."""
+        import dataclasses
+
+        from maggy_tpu import experiment as exp_mod
+
+        entry = handle.entry
+        sub = None
+        driver = None
+        try:
+            sub = exp_mod._begin_run(config, self.env, exclusive=False)
+            slots = entry.effective_max(self.num_runners)
+            cfg = dataclasses.replace(
+                config, fleet=FleetBinding(self, entry),
+                num_workers=max(1, slots))
+            driver = exp_mod.lagom_driver(cfg, sub.app_id, sub.run_id)
+            import atexit
+
+            atexit.register(exp_mod._exit_handler, driver)
+            try:
+                result = driver.run_experiment(train_fn)
+            finally:
+                atexit.unregister(exp_mod._exit_handler)
+            self.scheduler.finish(entry, "done")
+            handle._set_result(result)
+        except BaseException as exc:  # noqa: BLE001 - surface via the handle
+            self.scheduler.finish(entry, "failed")
+            handle._set_exception(exc)
+        finally:
+            if sub is not None:
+                exp_mod._end_run(sub)
+            self._dump_status()
+
+    # ------------------------------------------------------------- querying
+
+    def status(self) -> Dict[str, Any]:
+        snap = self.scheduler.snapshot()
+        return {"t": time.time(), "name": self.name,
+                "runners": self.num_runners, "pool": "thread",
+                "stopped": self._stopped, **snap}
+
+    def _dump_status(self) -> None:
+        try:
+            self.env.dump(json.dumps(self.status(), indent=2, default=str),
+                          self.home_dir + "/status.json")
+        except Exception:  # noqa: BLE001 - status mirror is best-effort
+            pass
+
+
+# ----------------------------------------------------------------- replay
+
+
+def replay_fleet_journal(path: str, env=None) -> Dict[str, Any]:
+    """Offline replay of a fleet journal: per-experiment queue waits,
+    lease-derived runner-seconds, share fractions over the window where
+    experiments overlapped (vs the weight-expected split), and preemption
+    counts. Pure — the same journal always reproduces the same numbers
+    (bench.py's ``detail.fleet`` block is exactly this call)."""
+    from maggy_tpu.telemetry import read_events
+    from maggy_tpu.telemetry.spans import _dist_stats
+
+    events = read_events(path, env=env)
+    exps: Dict[str, Dict[str, Any]] = {}
+    preempts = 0
+    last_t = 0.0
+
+    def exp(name: str) -> Dict[str, Any]:
+        return exps.setdefault(name, {
+            "submitted_t": None, "first_lease_t": None, "leases": [],
+            "open": {}, "preemptions": 0, "weight": 1.0, "priority": None,
+            "exp_dir": None})
+
+    for ev in events:
+        t = ev.get("t")
+        if isinstance(t, (int, float)):
+            last_t = max(last_t, t)
+        kind = ev.get("ev")
+        if kind == "fleet_submit":
+            e = exp(ev["exp"])
+            e["submitted_t"] = t
+            e["weight"] = float(ev.get("weight", 1.0))
+            e["priority"] = ev.get("priority")
+        elif kind == "lease":
+            e = exp(ev["exp"])
+            if ev.get("exp_dir"):
+                e["exp_dir"] = ev["exp_dir"]
+            key = (ev.get("runner"), ev.get("pid"))
+            if ev.get("phase") == "start":
+                e["open"][key] = t
+                if e["first_lease_t"] is None:
+                    e["first_lease_t"] = t
+            elif ev.get("phase") == "end":
+                t0 = e["open"].pop(key, None)
+                if t0 is not None and t is not None:
+                    e["leases"].append((t0, t))
+        elif kind == "preempt":
+            preempts += 1
+            exp(ev["exp"])["preemptions"] += 1
+        elif kind == "fleet_experiment":
+            e = exp(ev["exp"])
+            if ev.get("exp_dir"):
+                e["exp_dir"] = ev["exp_dir"]
+
+    queue_waits_ms: List[float] = []
+    out_exps: Dict[str, Dict[str, Any]] = {}
+    for name, e in exps.items():
+        for key, t0 in e["open"].items():  # journal ended mid-lease
+            e["leases"].append((t0, last_t))
+        e["open"] = {}
+        runner_s = sum(t1 - t0 for t0, t1 in e["leases"])
+        qw = None
+        if e["submitted_t"] is not None and e["first_lease_t"] is not None:
+            qw = e["first_lease_t"] - e["submitted_t"]
+            queue_waits_ms.append(qw * 1e3)
+        out_exps[name] = {
+            "runner_seconds": round(runner_s, 3),
+            "leases": len(e["leases"]),
+            "queue_wait_s": round(qw, 3) if qw is not None else None,
+            "preemptions": e["preemptions"],
+            "weight": e["weight"], "priority": e["priority"],
+            "exp_dir": e["exp_dir"],
+        }
+
+    # Fair-share check over the overlap window: the span in which EVERY
+    # leased experiment had started leasing and none had fully finished —
+    # outside it, a lone experiment legitimately takes the whole fleet.
+    share: Dict[str, float] = {}
+    expected: Dict[str, float] = {}
+    share_error = None
+    leased = {n: e for n, e in exps.items() if e["leases"]}
+    if len(leased) >= 2:
+        w0 = max(min(t0 for t0, _ in e["leases"]) for e in leased.values())
+        w1 = min(max(t1 for _, t1 in e["leases"]) for e in leased.values())
+        if w1 > w0:
+            clipped = {
+                n: sum(max(0.0, min(t1, w1) - max(t0, w0))
+                       for t0, t1 in e["leases"])
+                for n, e in leased.items()}
+            total = sum(clipped.values())
+            wsum = sum(e["weight"] for e in leased.values())
+            if total > 0 and wsum > 0:
+                share = {n: round(s / total, 3) for n, s in clipped.items()}
+                expected = {n: round(e["weight"] / wsum, 3)
+                            for n, e in leased.items()}
+                share_error = round(
+                    max(abs(share[n] - expected[n]) for n in share), 3)
+
+    return {
+        "experiments": out_exps,
+        "preemptions": preempts,
+        "share": share,
+        "expected_share": expected,
+        "share_error": share_error,
+        "queue_wait_ms": _dist_stats(queue_waits_ms),
+        "max_queue_wait_s": round(max(queue_waits_ms) / 1e3, 3)
+        if queue_waits_ms else None,
+        "torn_lines": getattr(events, "torn_lines", 0),
+    }

@@ -1,0 +1,167 @@
+"""Fleet soak: run concurrent experiments over one shared fleet and check
+the scheduling invariants from the journal artifacts.
+
+The standard scenario (``run_fleet_soak``): a low-priority "bulk" sweep
+takes the whole 2-runner fleet, then a high-priority "urgent" experiment
+with ``min_runners=1`` is submitted mid-flight — the scheduler must
+preempt one bulk trial (gracefully, checkpoint-assisted) to make room,
+both experiments must complete, and the fleet journal must show shares
+within the configured weights and no experiment starving past the bound.
+bench.py's ``--fleet`` mode wraps this and records the replayed numbers
+as its ``detail.fleet`` block (queue wait p50/p95, preemption count,
+share error).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from maggy_tpu.fleet.scheduler import (FLEET_JOURNAL_NAME, Fleet,
+                                       replay_fleet_journal)
+
+
+def demo_train_fn(lr, units, reporter=None, ctx=None):
+    """Closed-form fleet trial: checkpoints every step (TrialCheckpointer
+    ``checkpoints/<step>/`` layout) and resumes from ``ctx.resume_step``
+    after a preemption, broadcasting as it goes — module-level so CLI
+    spec files can name it (``maggy_tpu.fleet.soak:demo_train_fn``)."""
+    from maggy_tpu.chaos.harness import ckpt_train_fn
+
+    return ckpt_train_fn(lr, units, reporter=reporter, ctx=ctx)
+
+
+def run_fleet_soak(runners: int = 2, bulk_trials: int = 6,
+                   urgent_trials: int = 2, seed: int = 7,
+                   base_dir: Optional[str] = None,
+                   saturation_timeout_s: float = 30.0,
+                   preempt_grace_s: float = 0.25,
+                   starvation_bound_s: float = 10.0,
+                   hb_interval: float = 0.05) -> Dict[str, Any]:
+    """Execute the standard two-experiment preemption soak; returns a
+    report with ``ok``/``violations``, the fleet-journal replay, and the
+    ``detail`` block bench.py records. Pure artifact-checking: shares and
+    preemptions are derived from fleet.jsonl, the per-experiment
+    invariants (single FINAL, preempted-then-resumed) from each
+    experiment's own telemetry journal."""
+    from maggy_tpu import OptimizationConfig, Searchspace, experiment
+    from maggy_tpu.chaos.harness import check_invariants
+    from maggy_tpu.telemetry import JOURNAL_NAME, read_events
+
+    base_dir = base_dir or tempfile.mkdtemp(prefix="maggy_fleet_")
+    space = Searchspace(lr=("DOUBLE", [0.0, 0.2]),
+                        units=("INTEGER", [8, 64]))
+
+    def cfg(name: str, trials: int) -> OptimizationConfig:
+        return OptimizationConfig(
+            name=name, num_trials=trials, optimizer="randomsearch",
+            searchspace=space, direction="max", hb_interval=hb_interval,
+            hb_loss_timeout=5.0, seed=seed, es_policy="none",
+            experiment_dir=base_dir)
+
+    t0 = time.time()
+    fleet = Fleet(runners=runners, home_dir=os.path.join(base_dir, "fleet"),
+                  preempt_grace_s=preempt_grace_s)
+    with fleet:
+        bulk = experiment.lagom_submit(
+            demo_train_fn, cfg("bulk", bulk_trials), fleet=fleet,
+            priority="low", weight=1.0, block=False)
+        # The urgent arrival must hit a SATURATED fleet or there is
+        # nothing to preempt: wait until bulk actually holds every
+        # runner (driver startup latency varies), not a fixed delay.
+        deadline = time.monotonic() + saturation_timeout_s
+        while time.monotonic() < deadline:
+            if bulk.entry.allocated() >= runners:
+                break
+            time.sleep(0.02)
+        urgent = experiment.lagom_submit(
+            demo_train_fn, cfg("urgent", urgent_trials), fleet=fleet,
+            priority="high", weight=1.0, min_runners=1, max_runners=1,
+            block=False)
+        results = {"bulk": bulk.result(timeout=120),
+                   "urgent": urgent.result(timeout=120)}
+    wall_s = time.time() - t0
+
+    journal = os.path.join(fleet.home_dir, FLEET_JOURNAL_NAME)
+    replay = replay_fleet_journal(journal)
+    violations: List[str] = []
+
+    # Both experiments completed with their full schedules.
+    for name, trials in (("bulk", bulk_trials), ("urgent", urgent_trials)):
+        if results[name].get("num_trials") != trials:
+            violations.append(
+                "experiment {!r} finished {} of {} trials".format(
+                    name, results[name].get("num_trials"), trials))
+
+    # Per-experiment journal invariants: no lost trial, exactly one FINAL
+    # per trial, experiment finalized — plus the preempted-then-resumed
+    # chain for whatever the scheduler preempted.
+    preempted_total = 0
+    resumed_from: List[int] = []
+    for exp_dir in sorted(d for d in glob.glob(os.path.join(base_dir, "*"))
+                          if os.path.isdir(d) and d != fleet.home_dir):
+        jp = os.path.join(exp_dir, JOURNAL_NAME)
+        if not os.path.exists(jp):
+            continue
+        events = read_events(jp)
+        rep = check_invariants(events, stall_flag_bound_s=None)
+        violations.extend("{}: {}".format(os.path.basename(exp_dir), v)
+                          for v in rep["violations"])
+        for ev in events:
+            if ev.get("ev") != "trial":
+                continue
+            if ev.get("phase") == "preempted":
+                preempted_total += 1
+                if ev.get("checkpointed") and not any(
+                        e.get("phase") == "resumed"
+                        and e.get("trial") == ev.get("trial")
+                        and e.get("t", 0) >= ev.get("t", 0)
+                        for e in events):
+                    violations.append(
+                        "{}: trial {} preempted at checkpoint step {} but "
+                        "never resumed".format(os.path.basename(exp_dir),
+                                               ev.get("trial"),
+                                               ev.get("step")))
+            elif ev.get("phase") == "resumed" and \
+                    ev.get("from_step") is not None:
+                resumed_from.append(int(ev["from_step"]))
+
+    # The scheduler must actually have preempted (fleet journal) and the
+    # driver must have executed it (experiment journals agree).
+    if replay["preemptions"] < 1:
+        violations.append("no preemption: the urgent experiment joined a "
+                          "full fleet but the scheduler never preempted")
+    # Starvation bound — the fleet half of chaos invariant 7: every
+    # admitted experiment starts leasing within the bound.
+    mqw = replay.get("max_queue_wait_s")
+    if mqw is not None and mqw > starvation_bound_s:
+        violations.append(
+            "starvation: an experiment waited {:.2f}s for its first "
+            "runner (bound {:.1f}s)".format(mqw, starvation_bound_s))
+    for name in ("bulk", "urgent"):
+        if name not in replay["experiments"]:
+            violations.append(
+                "fleet journal has no lease record for {!r}".format(name))
+
+    detail = {
+        "queue_wait_ms": replay["queue_wait_ms"],
+        "preemptions": replay["preemptions"],
+        "share": replay["share"],
+        "expected_share": replay["expected_share"],
+        "share_error": replay["share_error"],
+        "max_queue_wait_s": replay["max_queue_wait_s"],
+        "resumed_from_steps": sorted(resumed_from),
+        "experiments": replay["experiments"],
+        "wall_s": round(wall_s, 2),
+    }
+    return {"ok": not violations, "violations": violations,
+            "results": {k: {"num_trials": v.get("num_trials"),
+                            "best_val": v.get("best_val"),
+                            "preemptions": v.get("preemptions", 0)}
+                        for k, v in results.items()},
+            "preempted": preempted_total,
+            "replay": replay, "journal": journal, "detail": detail,
+            "base_dir": base_dir}

@@ -11,6 +11,11 @@ driver process.
     python -m maggy_tpu.monitor --driver 10.0.0.2:41234 --secret-file s.txt --once
     python -m maggy_tpu.monitor --ticket .../runner_ticket.json --telem
     python -m maggy_tpu.monitor --ticket .../runner_ticket.json --health
+    python -m maggy_tpu.monitor --fleet ~/maggy_tpu_experiments/fleets/fleet
+
+``--fleet`` watches a shared fleet (maggy_tpu.fleet) from its home dir:
+per-experiment share vs configured weight, queue depth, and preemption
+counts, replayed from status.json + fleet.jsonl.
 
 ``--telem`` polls the TELEM verb instead: the driver's live telemetry
 snapshot (trial-span scheduling numbers + RPC service-time histograms).
@@ -188,6 +193,65 @@ def render_health(snap: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_fleet(status: Dict[str, Any],
+                 replay: Dict[str, Any]) -> str:
+    """Multi-line view of a fleet: scheduler status (from status.json)
+    plus journal-replayed shares/queue-waits/preemptions — who holds the
+    runners, who is waiting, and whether the split tracks the weights."""
+    if not status and not replay:
+        return "fleet: no status.json or fleet.jsonl yet"
+    lines = ["fleet {}: {} runner(s), {} active, queue depth {}{}".format(
+        status.get("name", "?"), status.get("runners", "?"),
+        status.get("active", 0), status.get("queue_depth", 0),
+        " [stopped]" if status.get("stopped") else "")]
+    shares = replay.get("share") or {}
+    expected = replay.get("expected_share") or {}
+    rexps = replay.get("experiments") or {}
+    for exp in status.get("experiments", []):
+        name = exp.get("name")
+        extra = ""
+        if name in shares:
+            extra = ", share {} (want {})".format(shares[name],
+                                                  expected.get(name))
+        qw = (rexps.get(name) or {}).get("queue_wait_s",
+                                         exp.get("queue_wait_s"))
+        lines.append(
+            "  {} [{}, prio {}, w {}]: {} runner(s), {} lease(s), "
+            "{} preemption(s), queue wait {}s{}".format(
+                name, exp.get("state"), exp.get("priority"),
+                exp.get("weight"), exp.get("allocated"), exp.get("leases"),
+                exp.get("preemptions"), qw, extra))
+    if replay.get("share_error") is not None:
+        lines.append("share error vs weights: {} (overlap window)".format(
+            replay["share_error"]))
+    if replay.get("preemptions"):
+        lines.append("preemptions: {}".format(replay["preemptions"]))
+    qwd = replay.get("queue_wait_ms") or {}
+    if qwd:
+        lines.append("queue wait: p50 {} ms / p95 {} ms (n={})".format(
+            qwd.get("median_ms"), qwd.get("p95_ms"), qwd.get("n")))
+    return "\n".join(lines)
+
+
+def _poll_fleet(home: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    import json as _json
+    import os as _os
+
+    from maggy_tpu.fleet import FLEET_JOURNAL_NAME, replay_fleet_journal
+
+    if home.endswith("status.json"):
+        home = _os.path.dirname(home)
+    status: Dict[str, Any] = {}
+    status_path = _os.path.join(home, "status.json")
+    if _os.path.exists(status_path):
+        with open(status_path) as f:
+            status = _json.load(f)
+    journal = _os.path.join(home, FLEET_JOURNAL_NAME)
+    replay = replay_fleet_journal(journal) if _os.path.exists(journal) \
+        else {}
+    return status, replay
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="maggy_tpu.monitor", description="Watch a running experiment.")
@@ -214,10 +278,29 @@ def main(argv=None) -> int:
                         "health engine plus per-partition runner stats "
                         "(step cadence, time-to-first-metric, heartbeat "
                         "RTT, RSS)")
+    p.add_argument("--fleet", metavar="HOME",
+                   help="watch a shared fleet instead of one experiment: "
+                        "renders per-experiment share, queue depth, and "
+                        "preemption counts from the fleet home dir's "
+                        "status.json + fleet.jsonl (no RPC — works after "
+                        "the fleet exits too)")
     args = p.parse_args(argv)
     if (args.telem or args.health) and args.logs:
         p.error("--logs streams over the LOG verb; run it without "
                 "--telem/--health (or use two monitor processes)")
+    if args.fleet:
+        if args.telem or args.health or args.logs:
+            p.error("--fleet is file-based; drop --telem/--health/--logs")
+        last = None
+        while True:
+            status, replay = _poll_fleet(args.fleet)
+            line = render_fleet(status, replay)
+            if line != last:
+                print(line, flush=True)
+                last = line
+            if args.once:
+                return 0
+            time.sleep(args.interval)
 
     if args.ticket:
         from maggy_tpu.runner import read_ticket

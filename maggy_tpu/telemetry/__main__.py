@@ -46,6 +46,13 @@ def main(argv=None) -> int:
     pr.add_argument("path", help="experiment dir or telemetry.jsonl path")
     args = p.parse_args(argv)
 
+    # A fleet home dir (fleet.jsonl present) renders the multiplexed
+    # timeline: one track per fleet RUNNER with a lane per experiment,
+    # built from the fleet journal + every leased experiment's journal.
+    if args.command == "trace" and os.path.isdir(args.path) and \
+            os.path.exists(os.path.join(args.path, "fleet.jsonl")):
+        return _fleet_trace(args)
+
     journal = _resolve_journal(args.path)
     if args.command == "replay":
         print(json.dumps(replay_journal(journal), indent=2, default=str))
@@ -60,6 +67,35 @@ def main(argv=None) -> int:
     if torn:
         msg += " ({} torn line(s) skipped)".format(torn)
     print(msg)
+    print("open in https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+def _fleet_trace(args) -> int:
+    """Fleet-mode trace: experiment journals are discovered from the
+    fleet journal's lease events (each carries its experiment's
+    exp_dir)."""
+    from maggy_tpu.telemetry.trace import build_fleet_trace, validate_trace
+
+    fleet_journal = os.path.join(args.path, "fleet.jsonl")
+    fleet_events = read_events(fleet_journal)
+    exp_dirs = {}
+    for ev in fleet_events:
+        if ev.get("exp") and ev.get("exp_dir"):
+            exp_dirs[ev["exp"]] = ev["exp_dir"]
+    experiments = {}
+    for name, exp_dir in exp_dirs.items():
+        jp = os.path.join(exp_dir, JOURNAL_NAME)
+        if os.path.exists(jp):
+            experiments[name] = read_events(jp)
+    trace = build_fleet_trace(fleet_events, experiments)
+    n = validate_trace(trace)
+    out = args.out or os.path.join(args.path, "fleet_trace.json")
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print("fleet trace: {} fleet events + {} experiment journal(s) -> {} "
+          "trace events -> {}".format(len(fleet_events), len(experiments),
+                                      n, out))
     print("open in https://ui.perfetto.dev or chrome://tracing")
     return 0
 

@@ -36,9 +36,15 @@ from typing import Any, Dict, List, Optional
 #: reply (journaled on the dispatched trial), ``prefetch_miss`` a FINAL
 #: whose freed runner had to fall back to GET polling (journaled on the
 #: finalized trial). hit/(hit+miss) is the pipeline's hit rate.
+#: ``preempt_requested`` -> ``preempted`` -> ``resumed`` are the
+#: checkpoint-assisted preemption edges (fleet scheduling / chaos
+#: preempt_trial): requested when the driver arms the preempt flag,
+#: preempted when the runner's ack lands (carrying the checkpoint step),
+#: resumed when the trial is re-dispatched with a ``resume_step``.
 PHASES = ("suggested", "queued", "assigned", "running", "first_metric",
           "stop_flagged", "stop_sent", "finalized", "lost", "requeued",
-          "profile_skipped", "prefetch_hit", "prefetch_miss")
+          "profile_skipped", "prefetch_hit", "prefetch_miss",
+          "preempt_requested", "preempted", "resumed")
 
 #: Gaps at or above this bound are scheduling (a runner idling on purpose at
 #: a rung barrier), not hand-off overhead — excluded from the gap stats.
@@ -161,6 +167,9 @@ def derive(events: List[Dict[str, Any]],
     early: set = set()
     hits = misses = 0
     suggest_ms: List[float] = []
+    preempted_at: Dict[str, List[float]] = {}
+    resumed_at: Dict[str, List[float]] = {}
+    preempt_resumed = 0
     for ev in events:
         if ev.get("ev") == "suggest":
             if ev.get("ms") is not None:
@@ -185,6 +194,11 @@ def derive(events: List[Dict[str, Any]],
             hits += 1
         elif phase == "prefetch_miss":
             misses += 1
+        elif phase == "preempted":
+            preempted_at.setdefault(trial, []).append(t)
+        elif phase == "resumed":
+            preempt_resumed += 1
+            resumed_at.setdefault(trial, []).append(t)
         elif phase == "lost":
             lost += 1
         elif phase == "requeued":
@@ -228,6 +242,20 @@ def derive(events: List[Dict[str, Any]],
                    "hit_rate": round(hits / (hits + misses), 3)
                    if (hits + misses) else None,
                    "latency": _dist_stats(suggest_ms)}
+    # Preemption -> resume latency: each preempted occurrence to the SAME
+    # trial's next resumed (checkpoint-assisted) re-dispatch.
+    preempt: Dict[str, Any] = {}
+    if preempted_at:
+        resume_lat = []
+        for tid, times in preempted_at.items():
+            marks = sorted(resumed_at.get(tid, []))
+            for t0 in times:
+                nxt = next((t for t in marks if t >= t0), None)
+                if nxt is not None:
+                    resume_lat.append((nxt - t0) * 1e3)
+        preempt = {"n": sum(len(v) for v in preempted_at.values()),
+                   "resumed": preempt_resumed,
+                   "resume_latency": _dist_stats(resume_lat)}
     return {
         "trials": {"created": len(created), "finalized": finalized,
                    "early_stopped": len(early), "errors": errors,
@@ -236,4 +264,5 @@ def derive(events: List[Dict[str, Any]],
         "early_stop_reaction": _dist_stats(reactions),
         "requeue_recovery": _dist_stats(recoveries),
         "suggest": suggest,
+        "preempt": preempt,
     }

@@ -59,6 +59,18 @@ class TrialCheckpointer:
             self.manager.close()
 
 
+def latest_checkpoint_step(trial_dir: str) -> Optional[int]:
+    """Newest checkpointed step under ``trial_dir`` or None — by listing
+    the CheckpointManager's per-step directory layout directly, so the
+    preemption ack path (which only needs the NUMBER) never pays the
+    orbax import or touches checkpoint I/O."""
+    path = os.path.join(trial_dir, "checkpoints")
+    if not os.path.isdir(path):
+        return None
+    steps = [int(name) for name in os.listdir(path) if name.isdigit()]
+    return max(steps) if steps else None
+
+
 def restore_parent_state(exp_dir: str, parent_trial_id: str,
                          abstract_state: Any) -> Optional[Any]:
     """Warm-start a promoted trial from its parent's checkpoint (the ASHA

@@ -50,6 +50,10 @@ class Trial:
         self.trial_id = Trial._compute_id(params, trial_type)
         self.status = Trial.PENDING
         self.early_stop = False
+        # Scheduler preemption in flight: the early-stop flag carries the
+        # STOP to the runner, this flag marks it as a preemption (the
+        # runner acks with a preempted FINAL instead of finalizing).
+        self.preempt = False
         self.final_metric: Optional[float] = None
         self.metric_history: List[float] = []
         self.step_history: List[int] = []
@@ -95,6 +99,14 @@ class Trial:
         with self.lock:
             self.early_stop = True
 
+    def get_preempt(self) -> bool:
+        with self.lock:
+            return self.preempt
+
+    def set_preempt(self) -> None:
+        with self.lock:
+            self.preempt = True
+
     def reset_run_state(self) -> None:
         """Discard the state of a dead run before a re-run.
 
@@ -106,6 +118,7 @@ class Trial:
         """
         with self.lock:
             self.early_stop = False
+            self.preempt = False
             self.final_metric = None
             self.metric_history = []
             self.step_history = []

@@ -107,7 +107,11 @@ def progress_bar(done: int, total: int, width: int = 30) -> str:
 
 def next_run_id(base_dir: str, app_id: str, env=None) -> int:
     """Monotonic run id per app id under the experiment base dir, checked
-    through the environment's filesystem (works for gs:// paths too)."""
+    through the environment's filesystem (works for gs:// paths too).
+
+    Scan only — racy by construction (two scanners can see the same next
+    id). Starters must go through ``claim_run_id``; this stays the read
+    path resume uses to FIND the most recent existing run."""
     from maggy_tpu.core.environment import EnvSing
 
     env = env or EnvSing.get_instance()
@@ -115,6 +119,38 @@ def next_run_id(base_dir: str, app_id: str, env=None) -> int:
     while env.exists("{}/{}_{}".format(base_dir.rstrip("/"), app_id, i)):
         i += 1
     return i
+
+
+#: Marker claimed atomically inside a run dir by the experiment that owns
+#: it (see claim_run_id).
+RUN_CLAIM_FILE = ".run_claim"
+
+
+def claim_run_id(base_dir: str, app_id: str, env=None) -> int:
+    """Atomically claim the next free run id: scan like ``next_run_id``,
+    then stake the run dir with ``AbstractEnv.exclusive_create`` (hard-link
+    exclusivity locally, if_generation_match=0 on GCS) so exactly ONE of N
+    concurrent starters — two lagom_submit threads, two processes sharing
+    a base dir — wins each id; losers move to the next. Closes the
+    scan-then-create TOCTOU that could mint the same run id twice (the
+    same fix PR 1 applied to DatasetRegistry.register)."""
+    import threading
+
+    from maggy_tpu.core.environment import EnvSing
+
+    env = env or EnvSing.get_instance()
+    base = base_dir.rstrip("/")
+    i = next_run_id(base, app_id, env=env)
+    while True:
+        run_dir = "{}/{}_{}".format(base, app_id, i)
+        if not env.exists(run_dir):
+            marker = "{}/{}".format(run_dir, RUN_CLAIM_FILE)
+            payload = json.dumps({"claimed_at": time.time(),
+                                  "pid": os.getpid(),
+                                  "thread": threading.get_ident()})
+            if env.exclusive_create(payload, marker):
+                return i
+        i += 1
 
 
 def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:

@@ -46,6 +46,12 @@ def pytest_configure(config):
         "bounds (e.g. the journal-replayed hand-off gap) — fast enough "
         "for tier-1, so hand-off regressions fail in CI instead of only "
         "surfacing in bench.py. Select with -m perf.")
+    config.addinivalue_line(
+        "markers",
+        "fleet: shared-fleet scheduler tests (maggy_tpu.fleet) — "
+        "multiplexing concurrent experiments over one runner fleet with "
+        "fair share, priorities, and checkpoint-assisted preemption. "
+        "Select with -m fleet.")
 
 
 @pytest.fixture(autouse=True)

@@ -15,8 +15,9 @@ import pytest
 from maggy_tpu import OptimizationConfig, Searchspace, experiment
 from maggy_tpu.chaos import (ChaosEngine, ChaosKilled, FaultPlan, FaultSpec,
                              arm, disarm)
-from maggy_tpu.chaos.harness import (check_invariants, default_plan,
-                                     piggyback_plan, run_soak)
+from maggy_tpu.chaos.harness import (check_invariants, ckpt_train_fn,
+                                     default_plan, piggyback_plan,
+                                     preempt_plan, run_soak)
 from maggy_tpu.core import rpc
 from maggy_tpu.core.environment import EnvSing
 from maggy_tpu.core.environment.abstractenvironment import LocalEnv
@@ -614,6 +615,95 @@ class TestPiggybackKillSoak:
         ]
         report = check_invariants(events)
         assert any("duplicate requeue" in v for v in report["violations"])
+
+
+class TestPreemptSoak:
+    """Invariant 7 end-to-end: a mid-trial GRACEFUL preemption (the fleet
+    scheduler's checkpoint-assisted mechanism, injected standalone via
+    the preempt_trial fault). The trial must ack with its checkpoint
+    step, resume from exactly that step (never 0), finalize exactly once,
+    and the experiment must complete."""
+
+    @pytest.mark.timeout(120)
+    def test_preempted_trial_resumes_from_checkpoint(self, tmp_path):
+        from maggy_tpu.telemetry import read_events
+
+        report = run_soak(plan=preempt_plan(seed=7), seed=7,
+                          train_fn=ckpt_train_fn, num_trials=8, workers=2,
+                          base_dir=str(tmp_path / "presoak"))
+        assert report["ok"], report["violations"]
+        assert report["faults"]["by_kind"] == {"preempt_trial": 1}
+        (rec,) = report["preemptions"]
+        assert rec["outcome"] == "preempted"
+        assert rec["checkpointed"] is True
+        assert rec["step"] >= 1
+        assert rec["from_step"] == rec["step"]
+        # The requeue edge carries the preempted reason, and the span
+        # chain preempt_requested -> preempted -> resumed is journaled.
+        events = read_events(report["journal"])
+        phases = [e.get("phase") for e in events
+                  if e.get("ev") == "trial"
+                  and e.get("trial") == rec["trial"]]
+        for phase in ("preempt_requested", "preempted", "requeued",
+                      "resumed"):
+            assert phase in phases, (phase, phases)
+        requeues = [e for e in events if e.get("ev") == "trial"
+                    and e.get("phase") == "requeued"
+                    and e.get("trial") == rec["trial"]]
+        assert [e.get("reason") for e in requeues] == ["preempted"]
+        finals = [e for e in events if e.get("ev") == "trial"
+                  and e.get("phase") == "finalized"
+                  and e.get("trial") == rec["trial"]]
+        assert len(finals) == 1
+        # derive() surfaces the preempt block (TELEM / monitor --telem).
+        d = derive(events)
+        assert d["preempt"]["n"] == 1
+        assert d["preempt"]["resumed"] == 1
+        assert d["preempt"]["resume_latency"]["n"] == 1
+
+    def test_preempt_plan_validation(self):
+        # Runner fault: per-message triggers are rejected at build.
+        with pytest.raises(ValueError, match="runner fault"):
+            FaultSpec("preempt_trial", trigger={"probability": 0.5})
+        spec = FaultSpec("preempt_trial",
+                         trigger={"on_phase": "first_metric", "nth": 2})
+        assert spec.count == 1  # one-shot by default, like other runner kinds
+
+    def test_invariant7_violations_detected(self):
+        # Checkpointed preemption that resumes from the wrong step.
+        events = [
+            {"t": 1.0, "ev": "trial", "trial": "a", "phase": "queued"},
+            {"t": 1.5, "ev": "chaos", "kind": "preempt_trial", "trial": "a",
+             "partition": 0},
+            {"t": 1.6, "ev": "trial", "trial": "a", "phase": "preempted",
+             "step": 3, "checkpointed": True},
+            {"t": 1.7, "ev": "trial", "trial": "a", "phase": "requeued",
+             "reason": "preempted"},
+            {"t": 1.9, "ev": "trial", "trial": "a", "phase": "resumed",
+             "from_step": 0},
+            {"t": 2.6, "ev": "trial", "trial": "a", "phase": "finalized"},
+            {"t": 3.0, "ev": "experiment", "phase": "end"},
+        ]
+        report = check_invariants(events)
+        assert any("resume step mismatch" in v for v in report["violations"])
+        # Checkpointed preemption that never resumes.
+        events[4] = {"t": 1.9, "ev": "trial", "trial": "b",
+                     "phase": "resumed", "from_step": 3}
+        report = check_invariants(events)
+        assert any("unresumed preemption" in v
+                   for v in report["violations"])
+        # A preemption outrun by the trial's own FINAL is benign.
+        events = [
+            {"t": 1.0, "ev": "trial", "trial": "a", "phase": "queued"},
+            {"t": 1.5, "ev": "chaos", "kind": "preempt_trial", "trial": "a",
+             "partition": 0},
+            {"t": 1.6, "ev": "trial", "trial": "a", "phase": "finalized"},
+            {"t": 3.0, "ev": "experiment", "phase": "end"},
+        ]
+        report = check_invariants(events)
+        assert report["ok"], report["violations"]
+        (rec,) = report["preemptions"]
+        assert rec["outcome"] == "completed_before_preempt"
 
 
 def train_process_soak(lr, units, reporter=None):

@@ -1,0 +1,522 @@
+"""Shared-fleet scheduler (maggy_tpu.fleet): multiplexing concurrent
+experiments over one persistent runner fleet — fair share, priority
+classes, quotas, admission, checkpoint-assisted preemption, the shared
+RPC listener, and the re-entrancy/run-id-claim fixes fleet concurrency
+forced."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from maggy_tpu import OptimizationConfig, Searchspace, experiment
+from maggy_tpu.core.environment import EnvSing
+from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+from maggy_tpu.fleet import (FLEET_JOURNAL_NAME, Fleet, FleetPolicy,
+                             FleetScheduler, priority_rank,
+                             replay_fleet_journal)
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def local_env(tmp_path):
+    env = LocalEnv(base_dir=str(tmp_path / "exp"))
+    EnvSing.set_instance(env)
+    yield env
+    EnvSing.reset()
+
+
+def train_quick(lr, units, reporter=None):
+    acc = 1.0 - ((lr - 0.1) ** 2 + ((units - 32) / 64.0) ** 2)
+    if reporter is not None:
+        for step in range(3):
+            time.sleep(0.02)
+            reporter.broadcast(acc * (step + 1) / 3.0, step=step)
+    return {"metric": acc}
+
+
+def space():
+    return Searchspace(lr=("DOUBLE", [0.0, 0.2]),
+                       units=("INTEGER", [8, 64]))
+
+
+def quick_config(name, trials, base_dir, seed=7):
+    return OptimizationConfig(
+        name=name, num_trials=trials, optimizer="randomsearch",
+        searchspace=space(), direction="max", hb_interval=0.05,
+        hb_loss_timeout=5.0, seed=seed, es_policy="none",
+        experiment_dir=base_dir)
+
+
+# --------------------------------------------------------------- policy
+
+
+class TestFleetPolicy:
+    def test_priority_ranks(self):
+        assert priority_rank("high") < priority_rank("normal") \
+            < priority_rank("low")
+        assert priority_rank(5) == 5
+        with pytest.raises(ValueError):
+            priority_rank("urgent-ish")
+        with pytest.raises(ValueError):
+            priority_rank(True)
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            FleetPolicy(weight=0)
+        with pytest.raises(ValueError):
+            FleetPolicy(min_runners=-1)
+        with pytest.raises(ValueError):
+            FleetPolicy(min_runners=3, max_runners=2)
+        with pytest.raises(ValueError):
+            FleetPolicy(priority="nope")
+
+
+# ------------------------------------------------------- scheduler units
+
+
+class _StubDriver:
+    experiment_done = False
+    exp_dir = None
+
+
+class TestSchedulerTargets:
+    def _sched(self, size):
+        return FleetScheduler(size)
+
+    def _entry(self, sched, name, **policy):
+        entry = sched.submit(name, FleetPolicy(**policy))
+        sched.activate(entry, _StubDriver(), lambda pid: None, slots=16)
+        return entry
+
+    def test_weighted_largest_remainder_within_class(self):
+        sched = self._sched(4)
+        self._entry(sched, "a", weight=3.0)
+        self._entry(sched, "b", weight=1.0)
+        with sched._lock:
+            targets = sched._targets_locked()
+        assert targets == {"a": 3, "b": 1}
+
+    def test_minimums_served_first_by_priority(self):
+        sched = self._sched(3)
+        self._entry(sched, "low", priority="low", min_runners=2)
+        self._entry(sched, "high", priority="high", min_runners=2)
+        with sched._lock:
+            targets = sched._targets_locked()
+        # High's guarantee first; low keeps what's left of its min.
+        assert targets["high"] == 2 and targets["low"] == 1
+
+    def test_max_runners_caps_fair_share(self):
+        sched = self._sched(4)
+        self._entry(sched, "capped", weight=10.0, max_runners=1)
+        self._entry(sched, "rest", weight=1.0)
+        with sched._lock:
+            targets = sched._targets_locked()
+        assert targets == {"capped": 1, "rest": 3}
+
+    def test_strict_priority_between_classes(self):
+        sched = self._sched(2)
+        self._entry(sched, "hi", priority="high")
+        self._entry(sched, "lo", priority="low")
+        with sched._lock:
+            targets = sched._targets_locked()
+        assert targets == {"hi": 2, "lo": 0}
+
+    def test_binding_prefers_deficit_then_releases_rebind(self):
+        sched = self._sched(2)
+        a = self._entry(sched, "a", weight=1.0)
+        b = self._entry(sched, "b", weight=1.0)
+        e1, p1 = sched.next_binding(0, timeout=1)
+        e2, p2 = sched.next_binding(1, timeout=1)
+        assert {e1.name, e2.name} == {"a", "b"}
+        # Both at target; a third runner would block (fleet_size reached
+        # anyway). Release a's runner: the rebind goes back to a (deficit).
+        held = a if e1.name == "a" else b
+        sched.release_binding(0 if e1 is held else 1, held,
+                              p1 if e1 is held else p2)
+        e3, _p3 = sched.next_binding(0, timeout=1)
+        assert e3.name == held.name
+
+    def test_admission_queue_caps_active(self):
+        sched = FleetScheduler(2, max_active=1)
+        first = sched.submit("first", FleetPolicy())
+        second = sched.submit("second", FleetPolicy(priority="high"))
+        assert first.state == "active"
+        assert second.state == "queued"  # cap reached, despite priority
+        sched.finish(first, "done")
+        assert second.state == "active"
+
+    def test_equal_class_oversubscription_rotates(self):
+        """Three equal-weight, equal-priority experiments on a 2-runner
+        fleet: the runner-less one must not starve until a peer's whole
+        experiment ends — after the grace period it preempts the peer
+        with the most weighted service (virtual-time rotation)."""
+        sched = self._sched(2)
+        preempted = []
+
+        class _Drv(_StubDriver):
+            def preempt_partition(self, pid, evict=False):
+                preempted.append((pid, evict))
+                return "trial-r"
+
+        a = self._entry(sched, "a")
+        b = self._entry(sched, "b")
+        sched.next_binding(0, timeout=1)
+        sched.next_binding(1, timeout=1)
+        a.driver = _Drv()
+        b.driver = _Drv()
+        time.sleep(0.05)  # let a/b accrue some virtual time
+        c = self._entry(sched, "c")
+        sched.preempt_grace_s = 0.0
+        assert sched.maybe_preempt() == 0  # arms c's deficit
+        assert sched.maybe_preempt() == 1  # rotation preempts a peer
+        assert preempted == [(0, True)]
+        assert c.allocated() == 0  # served once the evicted lease frees
+
+    def test_victim_is_lower_priority_over_share(self):
+        sched = self._sched(2)
+        lo = self._entry(sched, "lo", priority="low")
+        sched.next_binding(0, timeout=1)
+        sched.next_binding(1, timeout=1)
+        assert lo.allocated() == 2
+        hi = self._entry(sched, "hi", priority="high", min_runners=1,
+                         max_runners=1)
+        # Preemption needs the grace period to elapse first.
+        sched.preempt_grace_s = 0.0
+
+        class _Drv(_StubDriver):
+            preempted = []
+
+            def preempt_partition(self, pid, evict=False):
+                _Drv.preempted.append((pid, evict))
+                return "trial-x"
+
+        lo.driver = _Drv()
+        assert sched.maybe_preempt() == 0  # first sweep arms the deficit
+        assert sched.maybe_preempt() == 1
+        assert _Drv.preempted == [(1, True)]  # most recent lease, evicted
+        assert lo.preemptions == 1
+        assert hi.allocated() == 0  # binding happens when the lease frees
+
+
+# ----------------------------------------------------- shared RPC server
+
+
+class TestSharedServer:
+    @pytest.mark.timeout(60)
+    def test_routes_by_experiment_secret(self):
+        from maggy_tpu.core.rpc import Client, Server, SharedServer
+
+        shared = SharedServer()
+        s1 = Server(num_executors=1, secret="aa" * 16)
+        s2 = Server(num_executors=2, secret="bb" * 16)
+        addr1 = shared.attach(s1)
+        addr2 = shared.attach(s2)
+        assert addr1 == addr2  # one listener for both experiments
+        try:
+            c1 = Client(addr1, 0, 0, 1.0, s1.secret_hex)
+            c2 = Client(addr1, 0, 0, 1.0, s2.secret_hex)
+            # JOIN is rejected by both (no join_info) but proves dispatch;
+            # QUERY exercises per-server reservations state.
+            assert c1._request({"type": "QUERY"})["done"] is False
+            s1.reservations.add({"partition_id": 0})
+            assert c1._request({"type": "QUERY"})["done"] is True
+            # s2 needs TWO registrations — its state is independent.
+            assert c2._request({"type": "QUERY"})["done"] is False
+            s2.reservations.add({"partition_id": 0})
+            assert c2._request({"type": "QUERY"})["done"] is False
+            c1.stop()
+            c2.stop()
+            # Detach s1: its secret no longer authenticates.
+            s1.stop()
+            c1b = Client(addr1, 0, 0, 1.0, s1.secret_hex)
+            with pytest.raises(ConnectionError):
+                c1b._request({"type": "QUERY"})
+            for sock in (c1b._sock, c1b._hb_sock):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        finally:
+            shared.stop()
+
+    def test_wrong_secret_dropped(self):
+        from maggy_tpu.core.rpc import Client, Server, SharedServer
+
+        shared = SharedServer()
+        s1 = Server(num_executors=1, secret="cc" * 16)
+        addr = shared.attach(s1)
+        try:
+            bad = Client(addr, 0, 0, 1.0, "dd" * 16)
+            with pytest.raises(ConnectionError):
+                bad._request({"type": "QUERY"})
+        finally:
+            shared.stop()
+
+
+# --------------------------------------------------- e2e fleet scheduling
+
+
+@pytest.mark.timeout(120)
+class TestFleetSmoke:
+    """Tier-1 smoke: two tiny experiments share a 2-runner thread fleet,
+    both complete, and the journal-replayed shares sit within the
+    configured (equal) weights."""
+
+    def test_two_experiments_share_one_fleet(self, local_env, tmp_path):
+        base = str(tmp_path / "runs")
+        fleet = Fleet(runners=2, home_dir=str(tmp_path / "fleet"))
+        with fleet:
+            a = experiment.lagom_submit(
+                train_quick, quick_config("expa", 4, base, seed=3),
+                fleet=fleet, weight=1.0, block=False)
+            b = experiment.lagom_submit(
+                train_quick, quick_config("expb", 4, base, seed=4),
+                fleet=fleet, weight=1.0, block=False)
+            ra, rb = a.result(timeout=90), b.result(timeout=90)
+        assert ra["num_trials"] == 4 and rb["num_trials"] == 4
+        assert ra["best_val"] is not None and rb["best_val"] is not None
+        # Journal-replayed shares within the configured (equal) weights.
+        replay = replay_fleet_journal(
+            os.path.join(fleet.home_dir, FLEET_JOURNAL_NAME))
+        assert set(replay["experiments"]) == {"expa", "expb"}
+        assert replay["share_error"] is not None
+        assert replay["share_error"] <= 0.35, replay
+        assert replay["queue_wait_ms"]["n"] == 2
+        # Both experiments' artifacts landed under distinct run dirs.
+        run_dirs = [d for d in os.listdir(base)
+                    if os.path.isdir(os.path.join(base, d))]
+        assert len(run_dirs) == 2
+        # status.json mirrors the scheduler for monitor --fleet.
+        status = json.loads(
+            local_env.load(fleet.home_dir + "/status.json"))
+        assert status["runners"] == 2
+        assert {e["name"] for e in status["experiments"]} \
+            == {"expa", "expb"}
+        assert all(e["state"] == "done" for e in status["experiments"])
+
+    def test_plain_lagom_still_single_tenant(self, local_env, tmp_path):
+        """config.fleet off (the default): classic lagom semantics are
+        untouched — and a second concurrent lagom is still refused."""
+        base = str(tmp_path / "solo")
+        started = threading.Event()
+        release = threading.Event()
+
+        def slow_train(lr, units):
+            started.set()
+            release.wait(timeout=30)
+            return lr
+
+        holder = {}
+
+        def run():
+            holder["result"] = experiment.lagom(
+                slow_train, quick_config("solo", 1, base))
+
+        t = threading.Thread(target=run)
+        t.start()
+        try:
+            assert started.wait(timeout=30)
+            with pytest.raises(RuntimeError, match="already running"):
+                experiment.lagom(train_quick,
+                                 quick_config("second", 1, base))
+        finally:
+            release.set()
+            t.join(timeout=30)
+        assert holder["result"]["num_trials"] == 1
+        assert experiment.RUNNING is False
+
+
+@pytest.mark.timeout(120)
+class TestFleetPreemption:
+    """The full preempt/resume story (bench.py --fleet records this same
+    scenario's replay as detail.fleet): a high-priority arrival carves a
+    guaranteed runner out of a saturated low-priority sweep; the
+    preempted trial resumes from its checkpoint step."""
+
+    def test_preemption_soak_and_detail_block(self, tmp_path):
+        from maggy_tpu.fleet.soak import run_fleet_soak
+
+        report = run_fleet_soak(base_dir=str(tmp_path / "soak"))
+        assert report["ok"], report["violations"]
+        detail = report["detail"]
+        # The detail.fleet block bench.py records: queue wait p50/p95,
+        # preemption count, share error.
+        assert detail["queue_wait_ms"]["median_ms"] is not None
+        assert detail["queue_wait_ms"]["p95_ms"] is not None
+        assert detail["preemptions"] >= 1
+        assert detail["share_error"] is not None
+        # When the victim runner held a mid-trial checkpointed trial, the
+        # resume must come from its checkpoint step, never 0. (The victim
+        # may legally have been caught BETWEEN trials — evicted idle,
+        # nothing to resume; the deterministic mid-trial resume assertion
+        # is chaos invariant 7, tests/test_chaos.py::TestPreemptSoak.)
+        if detail["resumed_from_steps"]:
+            assert min(detail["resumed_from_steps"]) >= 1
+
+    def test_fleet_trace_renders_experiment_lanes(self, tmp_path):
+        from maggy_tpu.fleet.soak import run_fleet_soak
+        from maggy_tpu.telemetry import JOURNAL_NAME, read_events
+        from maggy_tpu.telemetry.trace import (build_fleet_trace,
+                                               validate_trace)
+
+        report = run_fleet_soak(base_dir=str(tmp_path / "soak"))
+        assert report["ok"], report["violations"]
+        fleet_events = read_events(report["journal"])
+        experiments = {}
+        for name, info in report["replay"]["experiments"].items():
+            experiments[name] = read_events(
+                os.path.join(info["exp_dir"], JOURNAL_NAME))
+        trace = build_fleet_trace(fleet_events, experiments)
+        assert validate_trace(trace) > 0
+        evs = trace["traceEvents"]
+        lanes = {(e["pid"], e["tid"]) for e in evs
+                 if e.get("cat") == "trial" and e.get("ph") == "X"}
+        # Trial slices landed on runner tracks in per-experiment lanes
+        # (tid distinguishes experiments within one runner's track).
+        assert len({tid for _pid, tid in lanes}) == 2
+        assert any(e.get("cat") == "lease" for e in evs)
+        assert any(e["name"].startswith("preempt:") for e in evs
+                   if e.get("ph") == "i")
+        thread_names = {(e["pid"], e["tid"]): e["args"]["name"]
+                        for e in evs if e.get("name") == "thread_name"}
+        assert any(v.startswith("exp ") for v in thread_names.values())
+
+
+# ------------------------------------------------------ re-entrancy fixes
+
+
+class TestReentrancyAndRunIdClaim:
+    def test_begin_run_exclusive_guard_is_atomic(self, local_env):
+        cfg = quick_config("guard", 1, local_env.base_dir)
+        subs, errors = [], []
+        barrier = threading.Barrier(4)
+        lock = threading.Lock()
+
+        def begin():
+            barrier.wait()
+            try:
+                sub = experiment._begin_run(cfg, local_env, exclusive=True)
+                with lock:
+                    subs.append(sub)
+            except RuntimeError:
+                with lock:
+                    errors.append(1)
+
+        threads = [threading.Thread(target=begin) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # Exactly ONE submission passes the exclusive guard — the
+        # unsynchronized module-global check let all four through.
+        assert len(subs) == 1 and len(errors) == 3
+        assert experiment.RUNNING is True
+        experiment._end_run(subs[0])
+        assert experiment.RUNNING is False
+
+    def test_concurrent_submissions_claim_distinct_run_ids(self, local_env):
+        cfg = quick_config("claim", 1, local_env.base_dir)
+        subs = []
+        barrier = threading.Barrier(6)
+        lock = threading.Lock()
+
+        def begin():
+            barrier.wait()
+            sub = experiment._begin_run(cfg, local_env, exclusive=False)
+            with lock:
+                subs.append(sub)
+
+        threads = [threading.Thread(target=begin) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        try:
+            run_ids = sorted(s.run_id for s in subs)
+            assert len(set(run_ids)) == 6  # no duplicate run id minted
+        finally:
+            for s in subs:
+                experiment._end_run(s)
+
+    def test_claim_run_id_is_toctou_proof(self, local_env, tmp_path):
+        from maggy_tpu import util
+
+        base = str(tmp_path / "claims")
+        claimed = []
+        barrier = threading.Barrier(8)
+        lock = threading.Lock()
+
+        def claim():
+            barrier.wait()
+            rid = util.claim_run_id(base, "app", env=local_env)
+            with lock:
+                claimed.append(rid)
+
+        threads = [threading.Thread(target=claim) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(claimed) == list(range(8))
+        # A claimed dir counts as existing for the next scan even before
+        # experiment.json lands in it.
+        assert util.next_run_id(base, "app", env=local_env) == 8
+
+
+# ----------------------------------------------------------- CLI + views
+
+
+class TestFleetCLIAndMonitor:
+    @pytest.mark.timeout(120)
+    def test_cli_start_runs_spec_and_spool(self, local_env, tmp_path):
+        from maggy_tpu.fleet.__main__ import main as fleet_main
+
+        home = str(tmp_path / "fleethome")
+        spec = {
+            "name": "cli_exp",
+            "train_fn": "maggy_tpu.fleet.soak:demo_train_fn",
+            "priority": "normal", "weight": 1.0,
+            "config": {"num_trials": 2, "optimizer": "randomsearch",
+                       "direction": "max", "hb_interval": 0.05,
+                       "seed": 5, "es_policy": "none",
+                       "searchspace": {"lr": ["DOUBLE", [0.0, 0.2]],
+                                       "units": ["INTEGER", [8, 64]]}},
+        }
+        spec_path = str(tmp_path / "spec.json")
+        with open(spec_path, "w") as f:
+            json.dump(spec, f)
+        rc = fleet_main(["start", "--home", home, "--runners", "2",
+                         "--spec", spec_path,
+                         "--base-dir", str(tmp_path / "runs"),
+                         "--poll", "0.2", "--idle-exit", "0.5"])
+        assert rc == 0
+        status = json.loads(local_env.load(home + "/status.json"))
+        assert [e["state"] for e in status["experiments"]] == ["done"]
+        # status subcommand renders from the same artifacts.
+        rc = fleet_main(["status", "--home", home])
+        assert rc == 0
+
+    def test_render_fleet_formatting(self):
+        from maggy_tpu.monitor import render_fleet
+
+        status = {"name": "f", "runners": 2, "active": 1, "queue_depth": 1,
+                  "experiments": [
+                      {"name": "bulk", "state": "active", "priority": "low",
+                       "weight": 1.0, "allocated": 1, "leases": 3,
+                       "preemptions": 1, "queue_wait_s": 0.1}]}
+        replay = {"share": {"bulk": 0.6}, "expected_share": {"bulk": 0.5},
+                  "share_error": 0.1, "preemptions": 1,
+                  "experiments": {"bulk": {"queue_wait_s": 0.1}},
+                  "queue_wait_ms": {"median_ms": 100.0, "p95_ms": 120.0,
+                                    "n": 2}}
+        out = render_fleet(status, replay)
+        assert "fleet f: 2 runner(s)" in out
+        assert "bulk [active, prio low, w 1.0]" in out
+        assert "share 0.6 (want 0.5)" in out
+        assert "share error vs weights: 0.1" in out
+        assert "queue wait: p50 100.0 ms / p95 120.0 ms" in out
+        assert render_fleet({}, {}).startswith("fleet: no status")

@@ -51,6 +51,81 @@ class TestRegistry:
         with pytest.raises(ValueError, match="immutable"):
             reg.register("toy", p, version=3)
 
+    def test_cross_experiment_register_concurrency(self, tmp_path):
+        """Two concurrent experiments registering the SAME dataset key
+        (the exact scenario trial_executor's shared-registry claim rests
+        on, now real under the fleet's concurrent submissions): exactly
+        one writer wins each (name, version); losers fail loudly instead
+        of silently overwriting, and a retry converges on a fresh
+        version."""
+        import threading
+
+        p = _write_npz(tmp_path)
+        schema = {"x": "float32", "y": "int64"}
+        barrier = threading.Barrier(2)
+        outcomes = {}
+
+        def register(exp):
+            # One registry instance per "experiment", same root — the
+            # cross-experiment shape (fleet submissions share the env).
+            reg = DatasetRegistry()
+            barrier.wait()
+            try:
+                outcomes[exp] = ("ok", reg.register("shared", p, version=1,
+                                                    schema=schema))
+            except ValueError as e:
+                outcomes[exp] = ("lost", str(e))
+
+        threads = [threading.Thread(target=register, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        results = sorted(kind for kind, _ in outcomes.values())
+        assert results == ["lost", "ok"], outcomes
+        # The losing experiment retries with auto-versioning and gets a
+        # fresh immutable version; the winner's manifest is intact.
+        reg = DatasetRegistry()
+        assert reg.register("shared", p, schema=schema) == 2
+        assert reg.versions("shared") == [1, 2]
+        loser_msg = next(msg for kind, msg in outcomes.values()
+                         if kind == "lost")
+        assert "registered" in loser_msg
+
+    def test_auto_version_concurrency_never_drops_a_writer(self, tmp_path):
+        """Auto-versioned concurrent registers: every thread either gets
+        a distinct version or a loud concurrent-registration error —
+        never a silent last-writer-wins overwrite."""
+        import threading
+
+        p = _write_npz(tmp_path)
+        schema = {"x": "float32", "y": "int64"}
+        barrier = threading.Barrier(4)
+        versions, errors = [], []
+        lock = threading.Lock()
+
+        def register():
+            reg = DatasetRegistry()
+            barrier.wait()
+            try:
+                v = reg.register("autokey", p, schema=schema)
+                with lock:
+                    versions.append(v)
+            except ValueError:
+                with lock:
+                    errors.append(1)
+
+        threads = [threading.Thread(target=register) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(versions) + len(errors) == 4
+        assert len(versions) == len(set(versions))  # winners all distinct
+        reg = DatasetRegistry()
+        assert reg.versions("autokey") == sorted(versions)
+
     def test_unknown_lookups_raise(self):
         reg = DatasetRegistry()
         with pytest.raises(KeyError, match="No dataset"):
