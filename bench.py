@@ -64,7 +64,9 @@ def _bench_loss(logits, batch):
 def train_mnist(lr, batch=256, budget=1, reporter=None):
     """One ASHA trial: budget-scaled training of the MNIST CNN. Shapes
     depend only on the DISCRETE batch hparam, so the whole sweep compiles
-    exactly len(BATCH_CHOICES) train steps (shared via step_key)."""
+    exactly len(BATCH_CHOICES) train steps — shared through the warm
+    cache's AUTOMATIC program key (model config + mesh + swept-optimizer
+    family; no hand-written step_key), the compile-once default."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -76,11 +78,12 @@ def train_mnist(lr, batch=256, budget=1, reporter=None):
 
     mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
     model = MnistCNN(kernel_size=3, pool_size=2, features=16, num_classes=2)
-    # lr rides in opt_state (swept_transform) and the step is shared via
-    # step_key: one compile per batch size for the whole sweep.
+    # lr rides in opt_state (swept_transform), so every trial of the sweep
+    # is the SAME program: repeat-shape trials reuse the warm slot's
+    # compiled step and donated state buffers.
     trainer = Trainer(
         model, swept_transform(optax.adam, learning_rate=lr),
-        _bench_loss, mesh, strategy="dp", step_key=("bench_mnist", "adam"),
+        _bench_loss, mesh, strategy="dp",
     )
     trainer.init(jax.random.key(0), (jnp.zeros((1, 16, 16, 1)),))
     steps = max(1, int(STEPS_PER_BUDGET * budget))
@@ -179,6 +182,68 @@ def log(msg):
     print("[bench] {}".format(msg), file=sys.stderr, flush=True)
 
 
+def run_compile_ab(trials=None, workers=1):
+    """Repeat-shape warm_start A/B (ROADMAP item 3's gate): the SAME
+    fixed-shape random-search sweep run twice on the SAME platform — warm
+    path on (the default) vs off (legacy build-per-trial). Returns per-arm
+    wall/ttfm numbers plus the gate: within the WARM run (cold first trial
+    vs warm repeats — same run, same platform, per the ROADMAP's flaky-TPU
+    comparability note), repeat-shape warm ttfm p50 must land >=5x below
+    the cold ttfm p50.
+    """
+    import functools
+    import glob as _glob
+
+    from maggy_tpu import OptimizationConfig, Searchspace, experiment
+    from maggy_tpu.telemetry import JOURNAL_NAME, replay_journal
+    from maggy_tpu.train import clear_warm
+
+    if trials is None:
+        trials = int(os.environ.get("BENCH_AB_TRIALS", "6"))
+    # Fixed batch/budget: every trial is the same program+shape, so trial
+    # 1 is the arm's only cold compile and 2..N are pure repeat-shape.
+    train_fn = functools.partial(train_mnist, batch=256, budget=0.5)
+    out = {}
+    for arm, warm_on in (("warm", True), ("cold", False)):
+        clear_warm()  # each arm starts from an empty warm cache
+        arm_dir = os.path.join(os.environ["MAGGY_TPU_BASE_DIR"],
+                               "compile_ab_{}".format(arm))
+        config = OptimizationConfig(
+            name="bench_ab_{}".format(arm), num_trials=trials,
+            optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE_LOG", [1e-4, 3e-2])),
+            direction="max", num_workers=workers, hb_interval=0.1,
+            es_policy="none", seed=11, warm_start=warm_on,
+            experiment_dir=arm_dir,
+        )
+        t0 = time.time()
+        experiment.lagom(train_fn, config)
+        wall = time.time() - t0
+        exp_dirs = sorted(d for d in _glob.glob(os.path.join(arm_dir, "*"))
+                          if os.path.isdir(d))
+        derived = replay_journal(os.path.join(exp_dirs[-1], JOURNAL_NAME))
+        comp = derived.get("compile") or {}
+        out[arm] = {
+            "wall_s": round(wall, 2),
+            "trials": trials,
+            "warm_hits": comp.get("warm_hits", 0),
+            "warm_misses": comp.get("warm_misses", 0),
+            "ttfm_warm": comp.get("ttfm_warm") or {},
+            "ttfm_cold": comp.get("ttfm_cold") or {},
+        }
+    warm_p50 = (out["warm"]["ttfm_warm"] or {}).get("median_ms")
+    cold_p50 = (out["warm"]["ttfm_cold"] or {}).get("median_ms")
+    gate = {"warm_ttfm_p50_ms": warm_p50, "cold_ttfm_p50_ms": cold_p50}
+    if warm_p50 and cold_p50:
+        gate["ratio"] = round(cold_p50 / warm_p50, 2)
+        gate["gate_ok"] = cold_p50 >= 5.0 * warm_p50
+    if out["warm"]["wall_s"] and out["cold"]["wall_s"]:
+        gate["trials_per_hour_ratio"] = round(
+            out["cold"]["wall_s"] / out["warm"]["wall_s"], 3)
+    out["gate"] = gate
+    return out
+
+
 def handoff_gaps(trials):
     """FALLBACK hand-off estimator from trial.json dicts (start+duration
     -> same runner's next start), for experiment dirs that predate the
@@ -231,12 +296,17 @@ def scheduling_telemetry(exp_dir, trial_dicts):
             # rate and controller suggest() latency (empty when the sweep
             # ran with config.prefetch=False or a pre-pipeline journal).
             "suggest": derived.get("suggest") or {},
+            # Compile-once hot path: warm-slot hit rate, ttfm split
+            # cold/warm, phase breakdown, persistent-cache counters
+            # (empty for warm_start=False or pre-warm journals).
+            "compile": derived.get("compile") or {},
             "source": "telemetry_journal",
             "journal": journal,
         }
     return {"handoff": handoff_gaps(trial_dicts),
             "early_stop_reaction": {},
             "suggest": {},
+            "compile": {},
             "source": "trial_json_fallback"}
 
 
@@ -533,6 +603,14 @@ def headline_main():
                 sched["suggest"].get("prefetch_misses"),
                 sched["suggest"].get("hit_rate"),
                 sched["suggest"].get("latency")))
+    if sched["compile"]:
+        log("compile-once: {} warm / {} cold (hit rate {}), ttfm p50 warm "
+            "{} vs cold {}".format(
+                sched["compile"].get("warm_hits"),
+                sched["compile"].get("warm_misses"),
+                sched["compile"].get("warm_hit_rate"),
+                (sched["compile"].get("ttfm_warm") or {}).get("median_ms"),
+                (sched["compile"].get("ttfm_cold") or {}).get("median_ms")))
     trace_path = _export_trace_artifact(exp_dirs[-1])
 
     # Two interleaved runs per baseline, keeping each baseline's MIN wall:
@@ -552,6 +630,22 @@ def headline_main():
     log("oracle replay (packed, no barriers, min of 2): {} trials in {:.1f}s".format(
         len(schedule), oracle_wall))
 
+    # Repeat-shape warm A/B: the compile-once gate (same platform as the
+    # headline — the ROADMAP's flaky-TPU note demands same-run baselines).
+    compile_ab = {}
+    try:
+        compile_ab = run_compile_ab()
+        log("compile A/B: gate {} (warm ttfm p50 {} ms vs cold {} ms, "
+            "ratio {}; wall warm {}s vs cold {}s)".format(
+                compile_ab["gate"].get("gate_ok"),
+                compile_ab["gate"].get("warm_ttfm_p50_ms"),
+                compile_ab["gate"].get("cold_ttfm_p50_ms"),
+                compile_ab["gate"].get("ratio"),
+                compile_ab["warm"]["wall_s"], compile_ab["cold"]["wall_s"]))
+    except Exception as e:  # noqa: BLE001 - A/B must not cost the headline
+        compile_ab = {"error": repr(e)}
+        log("compile A/B failed (headline unaffected): {!r}".format(e))
+
     print(json.dumps({
         "metric": HEADLINE_METRIC,
         "value": round(trials_per_hour, 1),
@@ -567,6 +661,8 @@ def headline_main():
             "handoff": handoff,
             "early_stop_reaction": sched["early_stop_reaction"],
             "suggest": sched["suggest"],
+            "compile": sched["compile"],
+            "compile_ab": compile_ab,
             "handoff_source": sched["source"],
             "trace": trace_path,
         },

@@ -26,7 +26,8 @@ import optax
 from maggy_tpu import OptimizationConfig, Searchspace, experiment
 from maggy_tpu.models import MnistCNN
 from maggy_tpu.parallel import make_mesh
-from maggy_tpu.train import ShardedBatchIterator, Trainer, cross_entropy_loss
+from maggy_tpu.train import (ShardedBatchIterator, Trainer,
+                             cross_entropy_loss, swept_transform)
 
 
 def make_mnist_like(n=4096, seed=0):
@@ -42,15 +43,26 @@ def make_mnist_like(n=4096, seed=0):
 X_TRAIN, Y_TRAIN = make_mnist_like()
 
 
+def loss_fn(logits, batch):
+    """Module-level (not a per-trial lambda) so the warm cache's automatic
+    program key matches across trials — see docs/user.md "Compile-once
+    sweeps"."""
+    return cross_entropy_loss(logits, batch["labels"])
+
+
 def train_fn(kernel, pool, dropout, lr, reporter=None):
-    """One trial: train the CNN, heartbeat val accuracy, return final acc."""
+    """One trial: train the CNN, heartbeat val accuracy, return final acc.
+
+    Compile-once: lr rides in opt_state (swept_transform), so trials that
+    share (kernel, pool, dropout) — the hparams that change the PROGRAM —
+    reuse the runner's warm-compiled step; only distinct model configs
+    recompile (bounded by the warm cache's LRU)."""
     mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
     model = MnistCNN(kernel_size=kernel, pool_size=pool, dropout=dropout,
                      num_classes=2)
     trainer = Trainer(
-        model, optax.adam(lr),
-        lambda logits, batch: cross_entropy_loss(logits, batch["labels"]),
-        mesh,
+        model, swept_transform(optax.adam, learning_rate=lr),
+        loss_fn, mesh,
     )
     trainer.init(jax.random.key(0), (jnp.zeros((1, 28, 28, 1)),))
     it = ShardedBatchIterator({"x": X_TRAIN, "y": Y_TRAIN}, batch_size=256,

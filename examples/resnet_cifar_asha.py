@@ -34,7 +34,8 @@ from maggy_tpu import OptimizationConfig, Searchspace, experiment
 from maggy_tpu.models import ResNet
 from maggy_tpu.optimizers import Asha
 from maggy_tpu.parallel import make_mesh
-from maggy_tpu.train import ShardedBatchIterator, Trainer, cross_entropy_loss
+from maggy_tpu.train import (ShardedBatchIterator, Trainer,
+                             cross_entropy_loss, swept_transform)
 
 DEPTH = 18  # overridden by --depth
 STEPS_PER_BUDGET = 8
@@ -52,15 +53,22 @@ def make_cifar_like(n=1024, seed=0):
 X_TRAIN, Y_TRAIN = make_cifar_like()
 
 
+def loss_fn(logits, batch):
+    return cross_entropy_loss(logits, batch["labels"])
+
+
 def train_fn(lr, width, weight_decay, budget=1, reporter=None):
     """One ASHA trial: budget-scaled ResNet training, data-parallel over
     every visible chip (GSPMD all-reduces gradients over ICI)."""
     mesh = make_mesh({"data": len(jax.devices())})
     model = ResNet(depth=DEPTH, num_classes=2, width=int(width))
+    # lr/weight_decay ride in opt_state (swept_transform) and the loss is
+    # module-level, so trials sharing a width reuse one warm-compiled
+    # step; only distinct widths (a PROGRAM hparam) recompile.
     trainer = Trainer(
-        model, optax.adamw(lr, weight_decay=weight_decay),
-        lambda logits, batch: cross_entropy_loss(logits, batch["labels"]),
-        mesh, strategy="dp", has_aux_collections=True,
+        model, swept_transform(optax.adamw, learning_rate=lr,
+                               weight_decay=weight_decay),
+        loss_fn, mesh, strategy="dp", has_aux_collections=True,
         train_kwargs={"train": True},
     )
     trainer.init(jax.random.key(0), (jnp.zeros((1, 32, 32, 3)),),

@@ -47,6 +47,10 @@ X_TRAIN, Y_TRAIN = make_cifar_like()
 
 
 def train_fn(lr, width, patch, reporter=None):
+    # Every swept hparam here (width/patch — and lr via a fresh adamw)
+    # changes the compiled program, so this sweep recompiles per config by
+    # design; see docs/user.md "Compile-once sweeps" for the swept_transform
+    # idiom when only optimizer hparams vary.
     cfg = ViTConfig(image_size=32, patch_size=int(patch), channels=3,
                     hidden_dim=int(width), intermediate_dim=2 * int(width),
                     num_layers=2, num_heads=2, num_classes=2)

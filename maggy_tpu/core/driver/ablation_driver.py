@@ -61,6 +61,7 @@ class AblationDriver(OptimizationDriver):
             ablation_resolver=self.controller.make_resolver(),
             profile=getattr(self.config, "profile", False),
             ship_prints=getattr(self.config, "ship_prints", False),
+            warm_start=getattr(self.config, "warm_start", True),
         )
 
     def _exp_startup_callback(self) -> None:

@@ -252,6 +252,7 @@ class OptimizationDriver(Driver):
                 "exp_dir": self.exp_dir,
                 "optimization_key": self.optimization_key,
                 "trial_type": "optimization",
+                "warm_start": getattr(self.config, "warm_start", True),
             }
             return RemoteRunnerPool(self)
         raise ValueError("Unknown pool type {!r}".format(pool))
@@ -267,6 +268,7 @@ class OptimizationDriver(Driver):
             trial_type="optimization",
             profile=getattr(self.config, "profile", False),
             ship_prints=getattr(self.config, "ship_prints", False),
+            warm_start=getattr(self.config, "warm_start", True),
         )
 
     def _validate_resume(self) -> None:

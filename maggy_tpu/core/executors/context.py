@@ -25,6 +25,17 @@ import os
 from typing import Any, Dict, Optional
 
 
+def info_needs_fresh_state(info: Dict[str, Any]) -> bool:
+    """Does a trial's assignment ``info`` dict mark it as CONTINUING
+    saved state (preemption resume / promoted parent)? The single home
+    of this rule: ``TrialContext.needs_fresh_state`` and the executor's
+    warm trial scope both consult it — widening it in one place but not
+    the other would silently re-enable retired-buffer donation for
+    exactly the trials that must restore a checkpoint instead."""
+    return (info.get("resume_step") is not None
+            or info.get("parent") is not None)
+
+
 class TrialContext:
     def __init__(
         self,
@@ -61,6 +72,18 @@ class TrialContext:
         before preemption — requeue-from-scratch)."""
         step = self.info.get("resume_step")
         return None if step is None else int(step)
+
+    @property
+    def needs_fresh_state(self) -> bool:
+        """True when this trial CONTINUES saved state — a preemption
+        resume (``resume_step``) or an ASHA/Hyperband promotion
+        (``parent_trial_id``). The warm harness (train/warm.py) consults
+        the same condition: such a trial must restore its checkpoint into
+        freshly initialized buffers, never consume the previous trial's
+        retired ones — the executor's trial scope arms ``fresh_state`` so
+        the warm slot's donation path is skipped while the compiled
+        executables are still reused."""
+        return info_needs_fresh_state(self.info)
 
     # ------------------------------------------------------- checkpointing
     def checkpointer(self):

@@ -92,6 +92,7 @@ class TrialExecutor:
         ablation_resolver: Optional[Callable] = None,
         profile: bool = False,
         ship_prints: bool = False,
+        warm_start: bool = True,
     ):
         self.server_addr = server_addr
         self.secret = secret
@@ -103,6 +104,7 @@ class TrialExecutor:
         self.ablation_resolver = ablation_resolver
         self.profile = profile
         self.ship_prints = ship_prints
+        self.warm_start = warm_start
 
     def __call__(self, partition_id: int) -> None:
         env = EnvSing.get_instance()
@@ -112,6 +114,12 @@ class TrialExecutor:
         # processes) with recurring shapes skip recompilation (SURVEY.md
         # §7.3 "compile-cache churn").
         util.enable_compile_cache()
+        # Warm-state harness: count warm-slot + persistent-cache events
+        # through jax.monitoring so the journal carries the compile-once
+        # hit rates (train/warm.py; never fatal).
+        from maggy_tpu.train import warm
+
+        warm.install_monitoring_listener()
         task_attempt = int(os.environ.get("MAGGY_TPU_TASK_ATTEMPT", "0"))
         reporter = Reporter(
             log_file="{}/executor_{}_{}.log".format(exp_dir, partition_id, task_attempt)
@@ -189,7 +197,24 @@ class TrialExecutor:
                         ctx = TrialContext(trial_id, trial_dir, exp_dir,
                                            params, client.last_info)
                         call_params["ctx"] = ctx
-                    retval = self._run_trial(call_params, trial_dir, reporter)
+                    # Warm-slot lifecycle around the trial fn: inside the
+                    # scope, Trainers default to the warm path
+                    # (config.warm_start), compile telemetry lands in this
+                    # runner's stats, and on exit the trial's state
+                    # buffers retire into the warm slot for the next
+                    # trial's donating re-init. A trial that RESUMES
+                    # state (preemption resume / promoted parent) must
+                    # restore its checkpoint, never touch retired
+                    # buffers — fresh_state forbids their reuse.
+                    from maggy_tpu.core.executors.context import \
+                        info_needs_fresh_state
+
+                    fresh = info_needs_fresh_state(client.last_info or {})
+                    with warm.trial_scope(trial_id=trial_id,
+                                          enabled=self.warm_start,
+                                          stats=stats, fresh_state=fresh):
+                        retval = self._run_trial(call_params, trial_dir,
+                                                 reporter)
                     metric = util.handle_return_val(
                         retval, trial_dir, self.optimization_key, env
                     )

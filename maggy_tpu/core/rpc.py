@@ -1488,6 +1488,32 @@ class Client:
         self._hb_stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=2)
+        stats = self.runner_stats
+        if stats is not None:
+            # Last-gasp stats flush: the final trial's pending records
+            # (e.g. its ``compile_events`` ttfm breakdown, finalized at
+            # trial end) would otherwise wait for a heartbeat that never
+            # comes — the GSTOP that ended the work loop also ends the
+            # beats. Idle-beat shaped (trial_id None), so the driver
+            # worker treats it like any other metric-free beat. ONE
+            # attempt, no retry loop, and a short socket deadline: a
+            # server that is already gone (or half-open after a severed
+            # connection) must not stall shutdown — without the clamp the
+            # 30 s request timeout applies to send AND recv.
+            try:
+                delta = stats.snapshot_delta()
+                if delta:
+                    msg = {"type": "METRIC", "trial_id": None,
+                           "value": None, "step": None, "logs": [],
+                           "span": None, "rstats": delta,
+                           "partition_id": self.partition_id,
+                           "task_attempt": self.task_attempt}
+                    with self._lock:
+                        self._sock.settimeout(2.0)
+                        MessageSocket.send_msg(self._sock, msg, self.secret)
+                        MessageSocket.recv_msg(self._sock, self.secret)
+            except Exception:  # noqa: BLE001 - shutdown must not fail
+                pass
         for sock in (self._sock, self._hb_sock):
             try:
                 sock.close()

@@ -119,6 +119,23 @@ def render_telem(snap: Dict[str, Any]) -> str:
                 suggest.get("prefetch_misses", 0),
                 suggest.get("hit_rate"),
                 _fmt_dist(suggest.get("latency") or {})))
+    comp = spans.get("compile") or {}
+    if comp:
+        # Compile-once hot path: how many trials rode a warm program vs
+        # paid a fresh trace+compile, and what each cost.
+        lines.append(
+            "compile-once: {} warm / {} cold (hit rate {}), ttfm warm "
+            "{} vs cold {}".format(
+                comp.get("warm_hits", 0), comp.get("warm_misses", 0),
+                comp.get("warm_hit_rate"),
+                _fmt_dist(comp.get("ttfm_warm") or {}),
+                _fmt_dist(comp.get("ttfm_cold") or {})))
+        cache = comp.get("cache") or {}
+        if cache:
+            lines.append(
+                "  xla persistent cache: {} hits / {} misses (hit rate "
+                "{})".format(cache.get("hits", 0), cache.get("misses", 0),
+                             cache.get("hit_rate")))
     hists = (snap.get("metrics") or {}).get("histograms") or {}
     rpc = sorted(((name, h) for name, h in hists.items()
                   if name.startswith("rpc.handle_ms.")),

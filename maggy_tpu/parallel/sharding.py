@@ -140,6 +140,24 @@ def batch_sharding(mesh, ndim: int = 2, shape=None):
     return NamedSharding(mesh, P(data_axes if data_axes else None, *rest))
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1024)
+def _cached_batch_sharding(mesh, shape):
+    return batch_sharding(mesh, shape=shape)
+
+
+def cached_batch_sharding(mesh, shape):
+    """``batch_sharding`` memoized by (mesh, leaf shape): the per-leaf
+    spec re-derivation is pure in both, so steady-state training steps
+    (Trainer.place_batch, data.py's device_put path) look the sharding up
+    instead of rebuilding it for every leaf of every batch. Meshes hash by
+    topology and the cache is bounded, so long-lived fleet runners hold at
+    most 1024 (mesh, shape) entries."""
+    return _cached_batch_sharding(mesh, tuple(shape))
+
+
 def validate_zero_strategy(mesh, strategy: str) -> bool:
     """True iff the "zero" part is active; raises on configurations where
     it would silently do the wrong thing instead of degrading quietly."""

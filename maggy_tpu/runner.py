@@ -141,6 +141,7 @@ def run_agent(
             train_fn=train_fn,
             trial_type=info.get("trial_type", "optimization"),
             profile=profile,
+            warm_start=info.get("warm_start", True),
         )
     executor(info["partition_id"])
     return info["partition_id"]

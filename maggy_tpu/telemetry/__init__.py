@@ -77,6 +77,9 @@ class Telemetry:
         self._runner_lock = threading.Lock()
         self._runner_state: Dict[int, Dict[str, Any]] = {}
         self._progress: Dict[int, float] = {}
+        # Trials whose compiled record already bumped the live registry
+        # counters (the journal itself is deduped by once=True).
+        self._compiled_seen: set = set()
 
     # ------------------------------------------------------------ recording
 
@@ -132,13 +135,15 @@ class Telemetry:
         pid = int(partition)
         stats = dict(stats)
         skipped = stats.pop("profile_skipped", None) or []
+        compile_events = stats.pop("compile_events", None) or []
         if stats:
             with self._runner_lock:
                 merged = self._runner_state.setdefault(pid, {})
                 merged.update(stats)
                 merged["updated_t"] = time.time()
             for key in ("hb_rtt_ms", "rss_mb", "dev_mem_mb", "cadence_ms",
-                        "ttfm_ms"):
+                        "ttfm_ms", "warm_hits", "warm_misses",
+                        "xla_cache_hits", "xla_cache_misses"):
                 if stats.get(key) is not None:
                     self.metrics.gauge(
                         "runner.{}.p{}".format(key, pid)).set(stats[key])
@@ -153,6 +158,25 @@ class Telemetry:
                           "partition": pid, **stats})
         for trial_id in skipped:
             self.trial_event(trial_id, "profile_skipped", partition=pid)
+        for record in compile_events:
+            # The runner's ttfm breakdown (warm/init_ms/trace_ms/
+            # compile_ms/first_step_ms) journaled as the trial's
+            # ``compiled`` span phase — once per span, so a re-delivered
+            # delta (requeued after a failed beat racing a successful
+            # one) cannot double-count the warm hit.
+            record = dict(record)
+            trial_id = record.pop("trial", None)
+            if not trial_id:
+                continue
+            self.trial_event(trial_id, "compiled", partition=pid,
+                             once=True, **record)
+            with self._runner_lock:
+                first = trial_id not in self._compiled_seen
+                self._compiled_seen.add(trial_id)
+            if first:
+                self.metrics.counter(
+                    "compile.warm_hits" if record.get("warm")
+                    else "compile.warm_misses").inc()
 
     def _note_progress(self, pid: int) -> None:
         with self._runner_lock:

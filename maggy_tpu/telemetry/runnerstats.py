@@ -17,7 +17,20 @@ heartbeat round-trip time, or memory attribution ever left the worker.
   experiences it, retries and backoff included;
 - **process RSS / device memory** — sampled at most every
   ``mem_interval_s`` via /proc (no psutil) and, when a JAX backend is
-  already initialized in this process, ``device.memory_stats()``.
+  already initialized in this process, ``device.memory_stats()``;
+- **compile attribution** (``note_compile``, fed by the warm harness in
+  train/warm.py) — the opaque ttfm split into phases: ``init_ms`` (sharded
+  state init), ``trace_ms``/``compile_ms`` (the AOT-split jaxpr trace and
+  XLA compile of the train step), ``first_step_ms`` (the residual at first
+  broadcast: dispatch + the first steps' device execution + input
+  staging), plus the trial's ``warm`` flag. Shipped once per trial as a
+  ``compile_events`` record (drained like ``profile_skipped``, requeued on
+  a failed beat) and journaled by the driver as a ``compiled`` span phase;
+- **warm/cache counters** (``note_counter``) — cumulative warm-slot and
+  persistent-compilation-cache hits/misses, attributed to THIS runner (a
+  thread-pooled process shares jax.monitoring globals, so the warm
+  harness routes counts through the trial scope to the right executor's
+  buffer).
 
 Shipping is piggybacked on the existing heartbeat METRIC payload
 (``rstats`` field) — no new socket, no new verb. ``snapshot_delta()``
@@ -115,6 +128,17 @@ class RunnerStats:
         self._last_mem_sample = 0.0
         self._profile_skipped: List[str] = []
         self._last_shipped: Dict[str, Any] = {}
+        # Compile attribution for the CURRENT trial (merged by
+        # note_compile; *_ms fields accumulate across e.g. the per-shape
+        # AOT compiles of one trial) and the finished records awaiting
+        # shipment (ship-once channel, requeued on a failed beat).
+        self._compile: Dict[str, Any] = {}
+        self._compile_final = False
+        self._ttfm_accounted: Optional[float] = None
+        self._compile_events: List[Dict[str, Any]] = []
+        # Cumulative warm-slot / compilation-cache counters for THIS
+        # runner (train/warm.py routes them here through the trial scope).
+        self._counters: Dict[str, int] = {}
 
     # ----------------------------------------------------------- recording
 
@@ -126,14 +150,59 @@ class RunnerStats:
             self._last_broadcast = None
             self._steps = 0
             self._ttfm_ms = None
+            self._compile = {}
+            self._compile_final = False
+            self._ttfm_accounted = None
 
     def trial_end(self, trial_id: Optional[str] = None) -> None:
         with self._lock:
             if trial_id is not None and trial_id != self._trial_id:
                 return
+            # The record ships at trial END, not first metric: phases
+            # recorded AFTER the first broadcast (a second batch shape
+            # compiling mid-trial) still accumulate into the one record.
+            # A trial that never broadcast (errored / metric-free) ships
+            # too — without the ttfm-derived first_step_ms residual.
+            self._finalize_compile_locked()
             self._trials_done += 1
             self._trial_id = None
             self._trial_t0 = None
+
+    def _finalize_compile_locked(self) -> None:
+        if self._compile_final or not self._compile:
+            return
+        record = dict(self._compile)
+        record["trial"] = self._trial_id
+        if self._ttfm_ms is not None:
+            record["ttfm_ms"] = round(self._ttfm_ms, 1)
+            # Residual vs the phases accounted BEFORE the first metric
+            # (snapshotted in on_broadcast) — a post-first-metric compile
+            # is not part of ttfm and must not eat into the residual.
+            record["first_step_ms"] = round(
+                max(0.0, self._ttfm_ms - (self._ttfm_accounted or 0.0)), 1)
+        for k in ("init_ms", "trace_ms", "compile_ms"):
+            if k in record:
+                record[k] = round(record[k], 1)
+        self._compile_events.append(record)
+        self._compile_final = True
+
+    def note_compile(self, **fields: Any) -> None:
+        """Merge compile-phase attribution for the current trial.
+        ``*_ms`` fields ACCUMULATE (a trial may compile several batch
+        shapes, before or after its first metric); others are
+        first-write-wins."""
+        with self._lock:
+            for k, v in fields.items():
+                if k.endswith("_ms"):
+                    self._compile[k] = self._compile.get(k, 0.0) + float(v)
+                else:
+                    self._compile.setdefault(k, v)
+
+    def note_counter(self, key: str, n: int = 1) -> None:
+        """Bump a cumulative runner counter (warm_hits/warm_misses/
+        xla_cache_hits/xla_cache_misses)."""
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
 
     def on_broadcast(self, step: Optional[int] = None) -> None:
         """One reporter.broadcast from the training loop. Pure arithmetic —
@@ -143,6 +212,13 @@ class RunnerStats:
             self._steps += 1
             if self._ttfm_ms is None and self._trial_t0 is not None:
                 self._ttfm_ms = (now - self._trial_t0) * 1e3
+                # First metric: snapshot the phase time attributed so far
+                # — the residual (ttfm minus this) is the first steps'
+                # actual execution (+ input staging). The record itself
+                # ships at trial end so later compiles still accumulate.
+                self._ttfm_accounted = sum(
+                    self._compile.get(k) or 0.0
+                    for k in ("init_ms", "trace_ms", "compile_ms"))
             if self._last_broadcast is not None:
                 gap_ms = (now - self._last_broadcast) * 1e3
                 self._cadence_ms = gap_ms if self._cadence_ms is None else \
@@ -204,13 +280,15 @@ class RunnerStats:
                 "rss_mb": self._rss_mb,
                 "dev_mem_mb": self._dev_mem_mb,
             }
+            snap.update(self._counters)
         return {k: v for k, v in snap.items()
                 if v is not None or k in ("trial", "ttfm_ms")}
 
     def snapshot_delta(self) -> Dict[str, Any]:
         """Fields changed since the last ship, plus any pending
-        profile_skipped trial ids (drained). Empty dict = nothing to ship
-        (the caller omits the ``rstats`` payload field entirely)."""
+        profile_skipped trial ids and finished compile records (both
+        drained, ship-once). Empty dict = nothing to ship (the caller
+        omits the ``rstats`` payload field entirely)."""
         current = self.snapshot()
         with self._lock:
             delta = {k: v for k, v in current.items()
@@ -219,6 +297,9 @@ class RunnerStats:
             if self._profile_skipped:
                 delta["profile_skipped"] = self._profile_skipped
                 self._profile_skipped = []
+            if self._compile_events:
+                delta["compile_events"] = self._compile_events
+                self._compile_events = []
         return delta
 
     def requeue_delta(self, delta: Dict[str, Any]) -> None:
@@ -229,6 +310,9 @@ class RunnerStats:
         with self._lock:
             skipped = delta.get("profile_skipped") or []
             self._profile_skipped = list(skipped) + self._profile_skipped
+            events = delta.get("compile_events") or []
+            self._compile_events = list(events) + self._compile_events
             for k, v in delta.items():
-                if k != "profile_skipped" and self._last_shipped.get(k) == v:
+                if k not in ("profile_skipped", "compile_events") \
+                        and self._last_shipped.get(k) == v:
                     del self._last_shipped[k]

@@ -41,10 +41,14 @@ from typing import Any, Dict, List, Optional
 #: preempt_trial): requested when the driver arms the preempt flag,
 #: preempted when the runner's ack lands (carrying the checkpoint step),
 #: resumed when the trial is re-dispatched with a ``resume_step``.
+#: ``compiled`` is an annotation carrying the runner-measured ttfm
+#: breakdown (warm flag + init_ms/trace_ms/compile_ms/first_step_ms/
+#: ttfm_ms — see telemetry/runnerstats.py): warm trials reuse the runner's
+#: resident program (train/warm.py), cold trials paid the XLA compile.
 PHASES = ("suggested", "queued", "assigned", "running", "first_metric",
           "stop_flagged", "stop_sent", "finalized", "lost", "requeued",
           "profile_skipped", "prefetch_hit", "prefetch_miss",
-          "preempt_requested", "preempted", "resumed")
+          "preempt_requested", "preempted", "resumed", "compiled")
 
 #: Gaps at or above this bound are scheduling (a runner idling on purpose at
 #: a rung barrier), not hand-off overhead — excluded from the gap stats.
@@ -152,6 +156,13 @@ def derive(events: List[Dict[str, Any]],
       and controller suggest() latency (``ev: "suggest"`` events with an
       ``ms`` field, recorded by the driver's suggester thread and inline
       fallback). Empty when the experiment ran without prefetch.
+    - ``compile``: the compile-once hot path's health — warm-slot hit
+      counts/rate from ``compiled`` phase events, ttfm distributions split
+      by cold/warm, the attributed phase distributions (init/trace/
+      compile/first_step), and the persistent XLA compilation cache's
+      cumulative hit/miss counts summed over runners (from the
+      ``runner_stats`` events' counter fields). Empty for pre-warm
+      journals.
     - ``trials``: lifecycle counts.
     """
     by_partition: Dict[int, List[tuple]] = {}
@@ -170,10 +181,29 @@ def derive(events: List[Dict[str, Any]],
     preempted_at: Dict[str, List[float]] = {}
     resumed_at: Dict[str, List[float]] = {}
     preempt_resumed = 0
+    compiled_recs: Dict[str, Dict[str, Any]] = {}
+    cache_cum: Dict[Any, Dict[str, int]] = {}
+    cache_banked: Dict[Any, Dict[str, int]] = {}
     for ev in events:
         if ev.get("ev") == "suggest":
             if ev.get("ms") is not None:
                 suggest_ms.append(float(ev["ms"]))
+            continue
+        if ev.get("ev") == "runner_stats":
+            # Cumulative per-runner counters: monotone within ONE runner
+            # process, but a replaced runner (chaos kill, pool respawn)
+            # restarts at zero — a value going backwards marks the new
+            # attempt, so bank the dead attempt's total instead of letting
+            # the overwrite erase it from the sums.
+            cum = cache_cum.setdefault(ev.get("partition"), {})
+            bank = cache_banked.setdefault(ev.get("partition"), {})
+            for key in ("xla_cache_hits", "xla_cache_misses",
+                        "warm_hits", "warm_misses"):
+                if ev.get(key) is not None:
+                    v = int(ev[key])
+                    if v < cum.get(key, 0):
+                        bank[key] = bank.get(key, 0) + cum[key]
+                    cum[key] = v
             continue
         if ev.get("ev") != "trial":
             continue
@@ -194,6 +224,8 @@ def derive(events: List[Dict[str, Any]],
             hits += 1
         elif phase == "prefetch_miss":
             misses += 1
+        elif phase == "compiled":
+            compiled_recs.setdefault(trial, ev)
         elif phase == "preempted":
             preempted_at.setdefault(trial, []).append(t)
         elif phase == "resumed":
@@ -256,6 +288,47 @@ def derive(events: List[Dict[str, Any]],
         preempt = {"n": sum(len(v) for v in preempted_at.values()),
                    "resumed": preempt_resumed,
                    "resume_latency": _dist_stats(resume_lat)}
+    # Compile-once hot path: warm hit rate + ttfm split cold/warm + the
+    # attributed phase distributions + persistent-cache counters.
+    compile_block: Dict[str, Any] = {}
+    if compiled_recs or any(cache_cum.values()):
+        def _counter_total(key):
+            return (sum(c.get(key, 0) for c in cache_cum.values())
+                    + sum(b.get(key, 0) for b in cache_banked.values()))
+
+        warm_recs = [r for r in compiled_recs.values() if r.get("warm")]
+        cold_recs = [r for r in compiled_recs.values() if not r.get("warm")]
+        hits_n, misses_n = len(warm_recs), len(cold_recs)
+        if not compiled_recs:
+            # No per-trial compiled records survived (runner died before
+            # its flush) but the heartbeat-shipped cumulative counters
+            # did — report THOSE instead of a contradictory zero.
+            hits_n = _counter_total("warm_hits")
+            misses_n = _counter_total("warm_misses")
+
+        def ms_dist(recs, key):
+            return _dist_stats([float(r[key]) for r in recs
+                                if r.get(key) is not None])
+
+        all_recs = list(compiled_recs.values())
+        compile_block = {
+            "warm_hits": hits_n, "warm_misses": misses_n,
+            "warm_hit_rate": round(hits_n / (hits_n + misses_n), 3)
+            if (hits_n + misses_n) else None,
+            "ttfm_cold": ms_dist(cold_recs, "ttfm_ms"),
+            "ttfm_warm": ms_dist(warm_recs, "ttfm_ms"),
+            "init_ms": ms_dist(all_recs, "init_ms"),
+            "trace_ms": ms_dist(all_recs, "trace_ms"),
+            "compile_ms": ms_dist(all_recs, "compile_ms"),
+            "first_step_ms": ms_dist(all_recs, "first_step_ms"),
+        }
+        cache_hits = _counter_total("xla_cache_hits")
+        cache_misses = _counter_total("xla_cache_misses")
+        if cache_hits or cache_misses:
+            compile_block["cache"] = {
+                "hits": cache_hits, "misses": cache_misses,
+                "hit_rate": round(cache_hits / (cache_hits + cache_misses),
+                                  3)}
     return {
         "trials": {"created": len(created), "finalized": finalized,
                    "early_stopped": len(early), "errors": errors,
@@ -265,4 +338,5 @@ def derive(events: List[Dict[str, Any]],
         "requeue_recovery": _dist_stats(recoveries),
         "suggest": suggest,
         "preempt": preempt,
+        "compile": compile_block,
     }

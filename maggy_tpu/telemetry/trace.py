@@ -54,6 +54,14 @@ _INSTANT_PHASES = ("suggested", "queued", "stop_flagged", "stop_sent",
                    "prefetch_miss", "preempt_requested", "preempted",
                    "resumed")
 
+#: ttfm-breakdown fields of a ``compiled`` event, rendered (in runtime
+#: order) as sequential sub-slices inside the attempt's ``startup`` window
+#: — the compile stall decomposed: sharded init, jaxpr trace, XLA compile,
+#: then the residual first steps' execution.
+_COMPILE_SLICES = (("init", "init_ms"), ("trace", "trace_ms"),
+                   ("compile", "compile_ms"), ("first_step",
+                                               "first_step_ms"))
+
 
 def _pid(partition: Optional[int]) -> int:
     return DRIVER_PID if partition is None else int(partition) + 1
@@ -185,6 +193,27 @@ def _trial_slices(trial_id: str, evs: List[Dict[str, Any]], us) -> List[dict]:
                         "ts": us(a), "dur": max(1, us(b) - us(a)),
                         "pid": _pid(partition), "tid": 0,
                         "args": {"trial": trial_id}})
+        # Runner-attributed ttfm breakdown: the compiled event carries
+        # DURATIONS (runner clock), so the sub-slices are laid out
+        # sequentially from the attempt's running edge — driver/runner
+        # clock skew shifts the anchor, never the widths.
+        compiled = next((e for e in attempt
+                         if e.get("phase") == "compiled"), None)
+        anchor = marks.get("running")
+        if compiled is not None and anchor is not None:
+            cursor = us(anchor)
+            warm_tag = "warm" if compiled.get("warm") else "cold"
+            for name, key in _COMPILE_SLICES:
+                ms = compiled.get(key)
+                if not ms or ms <= 0:
+                    continue
+                dur = max(1, int(round(ms * 1e3)))
+                out.append({"name": "{} ({})".format(name, warm_tag),
+                            "cat": "compile", "ph": "X", "ts": cursor,
+                            "dur": dur, "pid": _pid(partition), "tid": 0,
+                            "args": {"trial": trial_id, key: ms,
+                                     "warm": bool(compiled.get("warm"))}})
+                cursor += dur
     return out
 
 

@@ -8,7 +8,9 @@ from maggy_tpu.train.trainer import (
 )
 from maggy_tpu.train.data import ShardedBatchIterator
 from maggy_tpu.train.registry import DatasetRegistry
+from maggy_tpu.train.warm import clear_warm, warm_cache
 
 __all__ = ["cross_entropy_loss", "init_train_state", "make_train_step",
            "next_token_loss", "swept_transform", "Trainer",
-           "ShardedBatchIterator", "DatasetRegistry"]
+           "ShardedBatchIterator", "DatasetRegistry", "clear_warm",
+           "warm_cache"]

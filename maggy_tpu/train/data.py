@@ -86,11 +86,13 @@ class ShardedBatchIterator:
     def _place(self, batch):
         import jax
 
-        from maggy_tpu.parallel.sharding import batch_sharding
+        from maggy_tpu.parallel.sharding import cached_batch_sharding
 
         # shape= lets the seq-axis rule skip tensors whose dim 1 isn't a
-        # sequence dim (e.g. [B, features] labels on a seq-parallel mesh).
-        return {k: jax.device_put(v, batch_sharding(self.mesh, shape=v.shape))
+        # sequence dim (e.g. [B, features] labels on a seq-parallel mesh);
+        # the sharding is memoized by (mesh, shape) so the steady-state
+        # loop skips the per-leaf spec re-derivation.
+        return {k: jax.device_put(v, cached_batch_sharding(self.mesh, v.shape))
                 for k, v in batch.items()}
 
     @classmethod

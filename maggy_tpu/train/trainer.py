@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from maggy_tpu.parallel.sharding import batch_sharding, logical_axis_rules
+from maggy_tpu.parallel.sharding import logical_axis_rules
 
 
 def cross_entropy_loss(logits, labels):
@@ -68,46 +68,124 @@ def init_train_state(
 
     Returns (params, opt_state, shardings) where params is the full flax
     variables dict minus boxes. ``cache_key`` shares the jitted initializer
-    across trials of a sweep (same contract as Trainer's step_key).
+    across trials of a sweep (same contract as Trainer's step_key); the
+    shared entries live in the bounded warm cache (train/warm.py), so a
+    fleet runner serving many programs no longer grows without bound.
     """
-    init_kwargs = init_kwargs or {}
+    from maggy_tpu.train import warm as _warm
 
-    def init_fn(rng):
-        variables = model.init(rng, *example_inputs, **init_kwargs)
-        # "losses" holds per-apply sowed scalars (e.g. MoE aux loss) — it is
-        # recomputed every step, not trained state.
-        return {k: v for k, v in variables.items() if k != "losses"}
+    init_kwargs = init_kwargs or {}
+    if cache_key is not None:
+        slot, _ = _warm.warm_cache().slot(
+            ("manual_init", cache_key, model, mesh, strategy))
+    else:
+        # Uncached: a private throwaway slot — ONE init sequence lives in
+        # _init_state_via_slot, so the legacy and warm paths cannot
+        # diverge (the bit-for-bit promise of warm_start=False).
+        slot = _warm.WarmSlot(None)
+    params, opt_state, shardings, _hit, _ikey = _init_state_via_slot(
+        slot, model, tx, rng, example_inputs, mesh, strategy,
+        init_kwargs, allow_buffers=False)
+    return params, opt_state, shardings
+
+
+def _init_state_via_slot(slot, model, tx, rng, example_inputs, mesh,
+                         strategy, init_kwargs, allow_buffers: bool = True):
+    """Warm-slot init: get-or-build the per-input-shape init entry (jitted
+    initializer + shardings — ``jax.eval_shape`` and the unboxing pass run
+    once per program+shape, not once per trial), then initialize fresh
+    state. When the slot holds the previous trial's retired buffers and
+    ``allow_buffers``, the re-init DONATES them: XLA writes the fresh
+    values into the retired trial's memory (no alloc churn, no transient
+    double-residency on a packed HBM), and — for a matching swept-optimizer
+    family — the opt_state is rebuilt the same way with only the traced
+    hyperparameters rebound to this trial's values.
+
+    Returns (params, opt_state, shardings, warm_hit, init_key). Every
+    reuse path recomputes VALUES from ``rng``/``tx`` — state is never
+    inherited across trials, only memory and executables are.
+    """
+    from maggy_tpu.train import warm as _warm
+
+    init_kwargs = init_kwargs or {}
+    ikey = (_warm.shape_key(example_inputs),
+            repr(sorted(init_kwargs.items())), _warm.shape_key(rng))
 
     def build():
+        def init_fn(r):
+            variables = model.init(r, *example_inputs, **init_kwargs)
+            return {k: v for k, v in variables.items() if k != "losses"}
+
         abstract = jax.eval_shape(init_fn, rng)
         _, shardings = _unbox_and_specs(abstract, mesh, strategy)
 
-        def init_unboxed(rng):
-            variables = init_fn(rng)
-            plain, _ = _unbox_and_specs(variables, mesh, strategy)
+        def init_unboxed(r):
+            plain, _ = _unbox_and_specs(init_fn(r), mesh, strategy)
             return plain
 
-        return jax.jit(init_unboxed, out_shardings=shardings), shardings
+        return _warm._InitEntry(
+            jax.jit(init_unboxed, out_shardings=shardings), init_unboxed,
+            shardings)
 
-    if cache_key is not None:
-        shapes = jax.tree_util.tree_map(jnp.shape, example_inputs)
-        key = ("init", cache_key, model, mesh, strategy, repr(shapes),
-               repr(sorted(init_kwargs.items())))
-        with _STEP_CACHE_LOCK:
-            if key not in _STEP_CACHE:
-                _STEP_CACHE[key] = build()
-            init_jit, shardings = _STEP_CACHE[key]
+    entry, hit = slot.init_entry(ikey, build)
+    family = _warm.opt_family(tx)
+    if allow_buffers:
+        retired = entry.take_retired()
     else:
-        init_jit, shardings = build()
+        entry.drop_retired()
+        retired = None
+    params = opt_state = None
     with mesh:
-        params = init_jit(rng)
-        opt_state = tx.init(params["params"] if "params" in params else params)
+        if retired is not None:
+            old_vars, old_opt, old_family = retired
+            try:
+                if entry.reinit_jit is None:
+                    init_unboxed = entry.init_unboxed
+
+                    def reinit(r, old):
+                        del old  # donated: recycled memory, fresh values
+                        return init_unboxed(r)
+
+                    entry.reinit_jit = jax.jit(
+                        reinit, out_shardings=entry.shardings,
+                        donate_argnums=(1,))
+                params = entry.reinit_jit(rng, old_vars)
+            except Exception:  # noqa: BLE001 - donation is an optimization
+                params = None
+            if params is not None and family is not None \
+                    and old_family == family:
+                try:
+                    if entry.opt_family != family \
+                            or entry.opt_reinit_jit is None:
+                        entry.opt_tx, entry.opt_family = tx, family
+                        first_tx = tx
+
+                        def opt_reinit(p, old):
+                            del old  # donated
+                            return first_tx.init(p)
+
+                        entry.opt_reinit_jit = jax.jit(
+                            opt_reinit, donate_argnums=(1,))
+                    psub = params["params"] if "params" in params else params
+                    # The cached re-init traced the family's FIRST
+                    # transform, so its hyperparam constants must be
+                    # rebound to THIS trial's swept values.
+                    opt_state = _warm.rebind_hyperparams(
+                        entry.opt_reinit_jit(psub, old_opt),
+                        _warm.swept_info(tx)["hparams"])
+                except Exception:  # noqa: BLE001
+                    opt_state = None
+        if params is None:
+            params = entry.init_jit(rng)
+        if opt_state is None:
+            opt_state = tx.init(
+                params["params"] if "params" in params else params)
     from maggy_tpu.parallel.sharding import apply_zero_sharding
 
     opt_state = apply_zero_sharding(
         opt_state, mesh, strategy,
         lambda x, sh: jax.device_put(x, sh) if hasattr(x, "shape") else x)
-    return params, opt_state, shardings
+    return params, opt_state, entry.shardings, hit, ikey
 
 
 def make_train_step(
@@ -168,13 +246,6 @@ def make_train_step(
     return jax.jit(step, **jit_kwargs)
 
 
-import threading as _threading
-
-# Compiled-step sharing across trials (opt-in via Trainer(step_key=...)).
-_STEP_CACHE: Dict[Any, Callable] = {}
-_STEP_CACHE_LOCK = _threading.Lock()
-
-
 def _has_injected_hparams(state) -> bool:
     """True if any sub-state carries injected hyperparams (swept_transform
     may sit anywhere inside an optax.chain)."""
@@ -190,15 +261,53 @@ def swept_transform(opt_factory: Callable, **hparams):
     (carried in opt_state) instead of baked-in constants.
 
     ``swept_transform(optax.adam, learning_rate=lr)`` produces identical HLO
-    for every lr, so a sweep compiles its train step ONCE: combine with
-    ``Trainer(step_key=...)`` for in-process sharing, and the persistent
-    compilation cache dedups across runner processes (SURVEY.md §7.3
-    "compile-cache churn" — the TPU-native answer is hparams-as-inputs, not
-    N recompiles).
+    for every lr, so a sweep compiles its train step ONCE: the warm cache
+    (train/warm.py) auto-shares the compiled step across trials whose
+    optimizer FAMILY (factory + hyperparameter names) matches — no
+    ``step_key`` needed — and the persistent compilation cache dedups
+    across runner processes (SURVEY.md §7.3 "compile-cache churn" — the
+    TPU-native answer is hparams-as-inputs, not N recompiles).
     """
+    import numbers
+
     import optax
 
-    return optax.inject_hyperparams(opt_factory)(**hparams)
+    tx = optax.inject_hyperparams(opt_factory)(**hparams)
+    numeric = {k: v for k, v in hparams.items()
+               if isinstance(v, numbers.Real) and not isinstance(v, bool)}
+    statics = {k: v for k, v in hparams.items() if k not in numeric}
+
+    def repr_stable(v):
+        # A static hyperparameter joins the shared family only when its
+        # repr is value-determined. A schedule/callable/array reprs by
+        # object (memory address): two identical constructions would mint
+        # DISTINCT families — each trial a never-matching key churning
+        # genuinely-warm programs out of the bounded shared LRU. Such
+        # transforms stay family-less (private warm slot: AOT split and
+        # telemetry, no cross-object sharing).
+        if v is None or isinstance(v, (str, bytes, bool, numbers.Number)):
+            return True
+        if isinstance(v, (tuple, list)):
+            return all(repr_stable(x) for x in v)
+        return False
+
+    if all(repr_stable(v) for v in statics.values()):
+        static = tuple(sorted((k, repr(v)) for k, v in statics.items()))
+        family = ("{}.{}".format(
+            getattr(opt_factory, "__module__", "?"),
+            getattr(opt_factory, "__qualname__", repr(opt_factory))),
+            tuple(sorted(numeric)), static)
+    else:
+        family = None
+    try:
+        # The marker rides tx.init (a plain function, so setattr works —
+        # the GradientTransformation namedtuple itself rejects attributes):
+        # warm.opt_family/swept_info read it to derive the value-independent
+        # auto program key and the per-trial hyperparams to rebind.
+        tx.init._maggy_swept = {"family": family, "hparams": numeric}
+    except (AttributeError, TypeError):
+        pass  # exotic init callables: loses warm family sharing only
+    return tx
 
 
 class Trainer:
@@ -207,51 +316,111 @@ class Trainer:
     The per-trial training harness for HPO sweeps (models from the zoo,
     optax optimizer, metric heartbeats via the Reporter).
 
-    ``step_key``: opt-in compiled-step sharing for sweeps. Trials whose
-    (step_key, model, mesh, strategy) coincide reuse one jitted step — pair
-    it with ``swept_transform`` so the optimizer's hyperparameters live in
-    opt_state rather than the program. Include the optimizer family in the
+    **Warm path (default).** Program identity is derived automatically —
+    (model config, mesh topology, strategy, loss_fn, train_kwargs, and the
+    optimizer family for ``swept_transform`` transforms) — and trials whose
+    identity matches reuse one warm slot (train/warm.py): the jitted+
+    AOT-compiled step, the computed shardings, and the previous trial's
+    retired state buffers (consumed by a donating re-init). Build the
+    optimizer with ``swept_transform`` so hyperparameters ride in
+    opt_state and the whole sweep compiles once; a plain transform keys by
+    object identity (never shared across objects — its constants are baked
+    into the program). ``warm_start=False`` (or the executor's
+    ``config.warm_start=False``) restores the build-per-trial behavior
+    bit-for-bit.
+
+    ``step_key``: manual override of the automatic program key — trials
+    whose (step_key, model, mesh, strategy) coincide reuse one jitted step
+    regardless of optimizer identity. Include the optimizer family in the
     key if the sweep varies it (e.g. ``step_key=("mnist", "adam")``).
     """
 
     def __init__(self, model, tx, loss_fn, mesh, strategy: str = "dp",
                  train_kwargs: Optional[Dict[str, Any]] = None,
                  has_aux_collections: bool = False,
-                 step_key: Optional[tuple] = None):
+                 step_key: Optional[tuple] = None,
+                 warm_start: Optional[bool] = None):
+        from maggy_tpu.train import warm as _warm
+
         self.model = model
         self.tx = tx
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.strategy = strategy
+        self._warm_enabled = _warm.enabled() if warm_start is None \
+            else bool(warm_start)
         build = functools.partial(
             make_train_step, model, tx, loss_fn, mesh,
             train_kwargs=train_kwargs,
             has_aux_collections=has_aux_collections, strategy=strategy)
         self._step_key = step_key
         self._step_shared = step_key is not None
+        # Flax modules are frozen dataclasses and Mesh hashes by topology,
+        # so the key pins the program identity; loss_fn keys by object
+        # identity (a per-call lambda simply misses the cache — safe; a
+        # module-level loss shares). Manual step_key deliberately excludes
+        # tx (the user asserts hparams ride opt_state); the auto key
+        # includes the optimizer family/identity so differing programs can
+        # never share silently.
+        tkr = repr(sorted((train_kwargs or {}).items()))
+        self._slot = None
         if step_key is not None:
-            # Flax modules are frozen dataclasses and Mesh hashes by
-            # topology, so the key pins the program identity; tx is
-            # deliberately excluded (that's the point — see swept_transform).
-            # loss_fn keys by object identity: a per-call lambda simply
-            # misses the cache (safe), a module-level loss shares.
-            key = (step_key, model, mesh, strategy, has_aux_collections,
-                   loss_fn, repr(sorted((train_kwargs or {}).items())))
-            with _STEP_CACHE_LOCK:
-                if key not in _STEP_CACHE:
-                    _STEP_CACHE[key] = build()
-                self._step = _STEP_CACHE[key]
+            key = ("manual", step_key, model, mesh, strategy,
+                   has_aux_collections, loss_fn, tkr)
+            self._slot, _ = _warm.warm_cache().slot(key)
+        elif self._warm_enabled:
+            family = _warm.opt_family(self.tx)
+            if family is not None:
+                key = ("auto", model, mesh, strategy, has_aux_collections,
+                       loss_fn, tkr, family)
+                try:
+                    self._slot, _ = _warm.warm_cache().slot(key)
+                except TypeError:
+                    # Unhashable program component (e.g. a flax module
+                    # with a list-typed field): the DEFAULT path must
+                    # never reject a model that trained fine before —
+                    # degrade to a private slot (no cross-trial sharing,
+                    # AOT split and telemetry kept).
+                    self._slot = _warm.WarmSlot(None)
+            else:
+                # Plain/family-less transform: no safe cross-trial
+                # sharing, but a PRIVATE slot still buys the AOT
+                # trace/compile split and compile telemetry without
+                # churning the shared LRU.
+                self._slot = _warm.WarmSlot(None)
+        if self._slot is not None:
+            self._step = self._slot.ensure_step(build)
         else:
             self._step = build()
+        self._init_ikey = None
+        self._active_step = None
         self.variables = None
         self.opt_state = None
         self.shardings = None
+        _warm.register_trainer(self)
 
     def init(self, rng, example_inputs, init_kwargs=None):
-        self.variables, self.opt_state, self.shardings = init_train_state(
-            self.model, self.tx, rng, example_inputs, self.mesh,
-            self.strategy, init_kwargs=init_kwargs,
-            cache_key=self._step_key)
+        import time as _time
+
+        from maggy_tpu.train import warm as _warm
+
+        t0 = _time.perf_counter()
+        self._active_step = None
+        if self._slot is not None:
+            allow = self._warm_enabled and not _warm.fresh_state_only()
+            (self.variables, self.opt_state, self.shardings, hit,
+             self._init_ikey) = _init_state_via_slot(
+                self._slot, self.model, self.tx, rng, example_inputs,
+                self.mesh, self.strategy, init_kwargs,
+                allow_buffers=allow)
+            _warm.record_warm_event(hit)
+            _warm.note_compile(warm=bool(hit))
+        else:
+            self.variables, self.opt_state, self.shardings = init_train_state(
+                self.model, self.tx, rng, example_inputs, self.mesh,
+                self.strategy, init_kwargs=init_kwargs)
+            _warm.note_compile(warm=False)
+        _warm.note_compile(init_ms=(_time.perf_counter() - t0) * 1e3)
         if self._step_shared and not _has_injected_hparams(self.opt_state):
             import warnings
 
@@ -263,17 +432,96 @@ class Trainer:
                 stacklevel=2)
         return self
 
+    def retire_to_warm_cache(self) -> None:
+        """Hand this trainer's state buffers to its warm slot's init entry:
+        the next repeat-shape trial's re-init DONATES them — fresh values
+        into recycled memory. Called by the executor's trial scope at
+        trial end; after it, ``variables``/``opt_state`` are None (their
+        buffers now belong to the slot and will be invalidated by the
+        donation)."""
+        slot = self._slot
+        if slot is None or self.variables is None or self._init_ikey is None:
+            return
+        from maggy_tpu.train import warm as _warm
+
+        entry = slot.get_init(self._init_ikey)
+        if entry is not None:
+            entry.store_retired(self.variables, self.opt_state,
+                                _warm.opt_family(self.tx))
+            self.variables = None
+            self.opt_state = None
+
     def place_batch(self, batch: Dict[str, Any]):
+        from maggy_tpu.parallel.sharding import cached_batch_sharding
+
         def put(x):
-            sh = batch_sharding(self.mesh, shape=np.shape(x))
+            # Sharding memoized by (mesh, leaf shape): steady-state steps
+            # skip the per-leaf rule re-derivation (PartitionSpec building)
+            # the old per-step tree_map paid.
+            sh = cached_batch_sharding(self.mesh, np.shape(x))
             return jax.device_put(jnp.asarray(x), sh)
 
         return jax.tree_util.tree_map(put, batch)
 
+    def _resolve_step(self, batch):
+        """Warm AOT path: per-shape compiled executables cached on the
+        slot, so a repeat-shape trial skips trace AND compile and the
+        split is measured (trace_ms/compile_ms telemetry). Any AOT failure
+        permanently falls the slot back to the plain jit call — the warm
+        path degrades, never breaks."""
+        slot = self._slot
+        if slot is None or not self._warm_enabled or not slot.aot_ok:
+            return self._step
+        from maggy_tpu.train import warm as _warm
+
+        key = (self._init_ikey, _warm.shape_key(batch))
+        fn = slot.compiled_step(key)
+        if fn is None:
+            import time as _time
+
+            # One compile per (slot, shape), even when N runner threads'
+            # first trials race the same program — the losers wait on the
+            # winner's executable instead of compiling their own.
+            with slot.aot_lock:
+                fn = slot.compiled_step(key)
+                if fn is None:
+                    try:
+                        t0 = _time.perf_counter()
+                        lowered = self._step.lower(
+                            self.variables, self.opt_state, batch)
+                        t1 = _time.perf_counter()
+                        fn = lowered.compile()
+                        t2 = _time.perf_counter()
+                    except Exception:  # noqa: BLE001 - AOT is an optimization
+                        slot.aot_ok = False
+                        return self._step
+                    _warm.note_compile(trace_ms=(t1 - t0) * 1e3,
+                                       compile_ms=(t2 - t1) * 1e3)
+                    slot.store_compiled(key, fn)
+        return fn
+
     def step(self, batch: Dict[str, Any]) -> float:
         with self.mesh:
-            self.variables, self.opt_state, loss = self._step(
-                self.variables, self.opt_state, batch)
+            # Steady-state fast path: the batch shape is constant within
+            # a trial, so reuse the last resolved executable without
+            # recomputing its shape key (pure-Python per-step overhead on
+            # the exact path this harness optimizes). A shape change
+            # surfaces as the AOT executable's signature TypeError —
+            # re-resolve once and retry; the error is re-raised when
+            # re-resolution lands on the same fn (a genuine type error).
+            fn = self._active_step
+            if fn is None:
+                fn = self._resolve_step(batch)
+                self._active_step = fn
+            try:
+                out = fn(self.variables, self.opt_state, batch)
+            except TypeError:
+                refreshed = self._resolve_step(batch)
+                if refreshed is fn:
+                    raise
+                self._active_step = refreshed
+                out = refreshed(self.variables, self.opt_state, batch)
+            self.variables, self.opt_state, loss = out
         return loss
 
     def fit(self, batches, reporter=None, report_every: int = 1,

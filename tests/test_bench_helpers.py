@@ -271,3 +271,44 @@ class TestTraceArtifact:
             assert bench._export_trace_artifact(exp_dir) is None
         finally:
             monkeypatch.setattr(trace_mod, "write_trace", real)
+
+
+class TestSchedulingTelemetryCompile:
+    """detail.compile rides the same journal replay as handoff/suggest —
+    and pre-warm journals (or the trial.json fallback) degrade to an
+    empty block instead of crashing the bench."""
+
+    def _write_journal(self, exp_dir, events):
+        import json as _json
+
+        from maggy_tpu.telemetry import JOURNAL_NAME
+
+        with open(os.path.join(exp_dir, JOURNAL_NAME), "w") as f:
+            for ev in events:
+                f.write(_json.dumps(ev) + "\n")
+
+    def test_compile_block_replayed(self, tmp_path):
+        exp_dir = str(tmp_path)
+        self._write_journal(exp_dir, [
+            {"t": 1.0, "ev": "trial", "trial": "a", "phase": "compiled",
+             "partition": 0, "warm": False, "ttfm_ms": 4000.0,
+             "compile_ms": 2000.0},
+            {"t": 2.0, "ev": "trial", "trial": "b", "phase": "compiled",
+             "partition": 0, "warm": True, "ttfm_ms": 30.0},
+        ])
+        sched = bench.scheduling_telemetry(exp_dir, [])
+        assert sched["source"] == "telemetry_journal"
+        assert sched["compile"]["warm_hits"] == 1
+        assert sched["compile"]["ttfm_cold"]["median_ms"] == 4000.0
+
+    def test_pre_warm_journal_empty_block(self, tmp_path):
+        exp_dir = str(tmp_path)
+        self._write_journal(exp_dir, [
+            {"t": 1.0, "ev": "trial", "trial": "a", "phase": "queued"},
+        ])
+        assert bench.scheduling_telemetry(exp_dir, [])["compile"] == {}
+
+    def test_trial_json_fallback_has_empty_block(self, tmp_path):
+        sched = bench.scheduling_telemetry(str(tmp_path), [])
+        assert sched["source"] == "trial_json_fallback"
+        assert sched["compile"] == {}

@@ -1,0 +1,862 @@
+"""Compile-once trial hot path: warm-state harness, compile telemetry,
+and the no-stale-params guarantee.
+
+ROADMAP item 3. The tentpole claims under test:
+
+- program identity is derived automatically (model config + mesh +
+  strategy + swept-optimizer family) and repeat-shape trials share one
+  warm slot — the compiled step, the shardings, and the retired state
+  buffers consumed by a donating re-init;
+- the warm path NEVER leaks state: a warm trial's losses are bit-identical
+  to a cold runner's (buffers recycle, values recompute), a resumed/
+  promoted trial never consumes retired buffers, and warm_start=False
+  reproduces the legacy build-per-trial behavior;
+- the opaque ttfm splits into journaled phases (init/trace/compile/
+  first_step) with warm + persistent-cache hit rates, replayable from the
+  journal and rendered by monitor/trace/bench.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from maggy_tpu.models import MnistCNN
+from maggy_tpu.parallel import make_mesh
+from maggy_tpu.train import (Trainer, clear_warm, cross_entropy_loss,
+                             swept_transform, warm_cache)
+from maggy_tpu.train import warm
+from maggy_tpu.telemetry.runnerstats import RunnerStats
+
+
+def loss_fn(logits, batch):
+    return cross_entropy_loss(logits, batch["labels"])
+
+
+MODEL = MnistCNN(kernel_size=3, pool_size=2, features=4, num_classes=2)
+RNG = np.random.default_rng(0)
+X = RNG.normal(size=(32, 8, 8, 1)).astype(np.float32)
+Y = (RNG.normal(size=(32,)) > 0).astype(np.int32)
+EXAMPLE = (jnp.zeros((1, 8, 8, 1)),)
+
+
+def mesh1():
+    return make_mesh({"data": 1}, devices=jax.devices()[:1])
+
+
+def make_trainer(lr, warm_start=None, step_key=None, tx=None):
+    return Trainer(MODEL, tx or swept_transform(optax.adam, learning_rate=lr),
+                   loss_fn, mesh1(), warm_start=warm_start,
+                   step_key=step_key)
+
+
+def run_trial(lr, steps=3, warm_start=None, retire=True):
+    tr = make_trainer(lr, warm_start=warm_start)
+    tr.init(jax.random.key(0), EXAMPLE)
+    losses = []
+    for _ in range(steps):
+        batch = tr.place_batch({"inputs": (jnp.asarray(X),),
+                                "labels": jnp.asarray(Y)})
+        losses.append(float(tr.step(batch)))
+    if retire:
+        tr.retire_to_warm_cache()
+    return tr, losses
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    clear_warm()
+    yield
+    clear_warm()
+
+
+class TestProgramKeys:
+    def test_swept_family_shares_one_slot(self):
+        t1 = make_trainer(3e-3)
+        t2 = make_trainer(1e-4)
+        assert t1._slot is t2._slot
+        assert t1._step is t2._step
+        assert len(warm_cache()) == 1
+
+    def test_different_optimizer_family_does_not_share(self):
+        t1 = make_trainer(1e-3)
+        t2 = Trainer(MODEL, swept_transform(optax.sgd, learning_rate=1e-3),
+                     loss_fn, mesh1())
+        assert t1._slot is not t2._slot
+
+    def test_plain_tx_gets_private_slot(self):
+        n0 = len(warm_cache())
+        t1 = Trainer(MODEL, optax.adam(1e-3), loss_fn, mesh1())
+        t2 = Trainer(MODEL, optax.adam(1e-3), loss_fn, mesh1())
+        # Distinct transform objects may bake distinct constants into the
+        # program: never shared, and never churning the shared LRU.
+        assert t1._slot is not t2._slot
+        assert len(warm_cache()) == n0
+
+    def test_manual_step_key_still_shares(self):
+        t1 = make_trainer(1e-3, step_key=("k",))
+        t2 = make_trainer(5e-3, step_key=("k",))
+        assert t1._slot is t2._slot
+
+    def test_warm_start_false_is_legacy(self):
+        t = make_trainer(1e-3, warm_start=False)
+        assert t._slot is None
+        assert len(warm_cache()) == 0
+
+    def test_lambda_loss_misses(self):
+        t1 = Trainer(MODEL, swept_transform(optax.adam, learning_rate=1e-3),
+                     lambda o, b: cross_entropy_loss(o, b["labels"]), mesh1())
+        t2 = Trainer(MODEL, swept_transform(optax.adam, learning_rate=1e-3),
+                     lambda o, b: cross_entropy_loss(o, b["labels"]), mesh1())
+        assert t1._slot is not t2._slot
+
+    def test_unhashable_model_degrades_to_private_slot(self):
+        """The DEFAULT warm path must never reject a model that trained
+        fine before it existed — an unhashable program component (e.g. a
+        flax module holding a list-typed field) degrades to a private
+        slot instead of raising at Trainer construction."""
+        import flax.linen as nn
+
+        class ListModel(nn.Module):
+            feats: list  # lists are unhashable -> the module is too
+
+            @nn.compact
+            def __call__(self, x):
+                for f in self.feats:
+                    x = nn.Dense(f)(x)
+                return x
+
+        n0 = len(warm_cache())
+        t = Trainer(ListModel(feats=[4, 2]),
+                    swept_transform(optax.adam, learning_rate=1e-3),
+                    loss_fn, mesh1())
+        assert t._slot is not None  # private: AOT split + telemetry kept
+        assert len(warm_cache()) == n0  # and the shared LRU untouched
+
+    def test_schedule_hparam_is_family_less(self):
+        """A schedule/callable hyperparameter reprs by object id: two
+        identical constructions would mint DISTINCT families, each trial
+        a never-matching shared-LRU key evicting genuinely warm programs.
+        Such transforms must stay family-less (private slot)."""
+        sched = optax.cosine_decay_schedule(0.1, 100)
+        tx = swept_transform(optax.adam, learning_rate=sched)
+        assert warm.opt_family(tx) is None
+        n0 = len(warm_cache())
+        t1 = Trainer(MODEL, tx, loss_fn, mesh1())
+        t2 = Trainer(
+            MODEL,
+            swept_transform(optax.adam,
+                            learning_rate=optax.cosine_decay_schedule(
+                                0.1, 100)),
+            loss_fn, mesh1())
+        assert t1._slot is not t2._slot
+        assert len(warm_cache()) == n0  # the shared LRU is not churned
+
+    def test_stringly_static_hparams_still_share(self):
+        """Repr-stable statics (str/bool/numbers/tuples) keep the family:
+        identical constructions share one program."""
+        f1 = warm.opt_family(swept_transform(
+            optax.adamw, learning_rate=1e-3, weight_decay=1e-4))
+        f2 = warm.opt_family(swept_transform(
+            optax.adamw, learning_rate=3e-3, weight_decay=5e-4))
+        assert f1 is not None and f1 == f2
+
+
+class TestWarmCacheBounds:
+    def test_lru_bound_and_clear(self):
+        cache = warm.WarmCache(maxsize=2)
+        a, hit_a = cache.slot("a")
+        assert not hit_a
+        cache.slot("b")
+        cache.slot("c")  # evicts "a"
+        assert len(cache) == 2
+        assert "a" not in cache.keys()
+        a2, hit_a2 = cache.slot("a")
+        assert not hit_a2 and a2 is not a
+        cache.clear()
+        assert len(cache) == 0
+
+    def test_lru_touch_refreshes(self):
+        cache = warm.WarmCache(maxsize=2)
+        cache.slot("a")
+        cache.slot("b")
+        cache.slot("a")  # touch
+        cache.slot("c")  # evicts "b", not "a"
+        assert set(cache.keys()) == {"a", "c"}
+
+    def test_env_bound(self, monkeypatch):
+        monkeypatch.setenv("MAGGY_TPU_WARM_SLOTS", "3")
+        assert warm.WarmCache().maxsize == 3
+
+
+class TestShardingMemo:
+    """Satellite: place_batch/data.py reuse one memoized sharding per
+    (mesh, shape) instead of re-deriving specs per leaf per step."""
+
+    def test_cached_batch_sharding_memoizes(self):
+        from maggy_tpu.parallel.sharding import (batch_sharding,
+                                                 cached_batch_sharding)
+
+        m = mesh1()
+        a = cached_batch_sharding(m, (8, 4))
+        assert cached_batch_sharding(m, (8, 4)) is a
+        assert a == batch_sharding(m, shape=(8, 4))
+        assert cached_batch_sharding(m, (8, 2)) == \
+            batch_sharding(m, shape=(8, 2))
+
+    def test_distinct_meshes_do_not_collide(self):
+        from maggy_tpu.parallel.sharding import cached_batch_sharding
+
+        m1 = make_mesh({"data": 1}, devices=jax.devices()[:1])
+        m2 = make_mesh({"data": 2}, devices=jax.devices()[:2])
+        assert cached_batch_sharding(m1, (8, 4)).mesh is m1
+        assert cached_batch_sharding(m2, (8, 4)).mesh is m2
+
+
+class TestRebindHyperparams:
+    def test_rebinds_injected_values_only(self):
+        tx = swept_transform(optax.adam, learning_rate=2e-3)
+        params = {"w": jnp.zeros((3,))}
+        state = tx.init(params)
+        rebound = warm.rebind_hyperparams(state, {"learning_rate": 9e-1,
+                                                  "not_there": 1.0})
+        assert float(rebound.hyperparams["learning_rate"]) == \
+            pytest.approx(9e-1)
+        assert rebound.hyperparams["learning_rate"].dtype == \
+            state.hyperparams["learning_rate"].dtype
+        # non-hyperparam leaves untouched
+        assert jax.tree_util.tree_structure(rebound) == \
+            jax.tree_util.tree_structure(state)
+
+    def test_plain_state_passthrough(self):
+        tx = optax.adam(1e-3)
+        state = tx.init({"w": jnp.zeros((3,))})
+        rebound = warm.rebind_hyperparams(state, {"learning_rate": 1.0})
+        assert jax.tree_util.tree_structure(rebound) == \
+            jax.tree_util.tree_structure(state)
+
+
+class TestNoStateLeak:
+    """The acceptance bar: the warm path never changes training values."""
+
+    def test_warm_trials_match_cold_bitwise(self):
+        _, w1 = run_trial(3e-3)
+        _, w2 = run_trial(1e-3)          # warm: donated buffers + rebind
+        _, w3 = run_trial(7e-4)
+        _, c1 = run_trial(3e-3, warm_start=False)
+        _, c2 = run_trial(1e-3, warm_start=False)
+        _, c3 = run_trial(7e-4, warm_start=False)
+        assert w1 == c1
+        assert w2 == c2, "stale params leaked through the warm slot"
+        assert w3 == c3
+
+    def test_warm_hit_counted_and_buffers_consumed(self):
+        c0 = warm.counters()
+        t1, _ = run_trial(3e-3)
+        slot = t1._slot
+        entry = slot.get_init(t1._init_ikey)
+        assert entry is not None and entry.retired is not None
+        assert t1.variables is None, "retired trainer must drop its refs"
+        t2, _ = run_trial(1e-3)
+        assert entry.retired is not None, "trial 2 should re-retire"
+        delta = {k: warm.counters()[k] - c0[k] for k in c0}
+        assert delta["warm_hits"] == 1 and delta["warm_misses"] == 1
+
+    def test_fresh_state_scope_skips_retired_buffers(self):
+        t1, _ = run_trial(3e-3)
+        entry = t1._slot.get_init(t1._init_ikey)
+        assert entry.retired is not None
+        with warm.trial_scope(trial_id="resumed", enabled=True,
+                              fresh_state=True):
+            t2 = make_trainer(1e-3)
+            t2.init(jax.random.key(0), EXAMPLE)
+            # A resume/promotion trial restores a checkpoint: the previous
+            # trial's buffers are DROPPED (memory freed), never donated
+            # into its state...
+            assert entry.retired is None
+            # ...it still reuses the compiled program...
+            assert t2._slot is t1._slot
+            # ...and its pre-restore values are a bit-fresh init.
+            t_cold = make_trainer(1e-3, warm_start=False)
+            t_cold.init(jax.random.key(0), EXAMPLE)
+            for a, b in zip(jax.tree_util.tree_leaves(t2.variables),
+                            jax.tree_util.tree_leaves(t_cold.variables)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # At scope exit the RESUMED trial's own buffers retire normally —
+        # the next plain trial may donate them.
+        assert entry.retired is not None
+
+    def test_scope_disabled_forces_legacy(self):
+        with warm.trial_scope(trial_id="t", enabled=False):
+            t = make_trainer(1e-3)
+        assert t._slot is None
+
+
+class TestRunnerStatsCompile:
+    def test_ms_fields_accumulate_and_ship_at_trial_end(self):
+        stats = RunnerStats()
+        stats.trial_start("t1")
+        stats.note_compile(warm=False, init_ms=100.0)
+        stats.note_compile(trace_ms=50.0, compile_ms=200.0)
+        stats.note_compile(trace_ms=25.0)  # second shape: accumulates
+        stats.on_broadcast(0)
+        # The record ships at trial END so a compile AFTER the first
+        # metric (a new batch shape mid-trial) still lands in it...
+        assert not stats.snapshot_delta().get("compile_events")
+        stats.note_compile(trace_ms=10.0, compile_ms=40.0)
+        stats.trial_end("t1")
+        events = stats.snapshot_delta()["compile_events"]
+        assert len(events) == 1
+        rec = events[0]
+        assert rec["trial"] == "t1" and rec["warm"] is False
+        assert rec["trace_ms"] == 85.0 and rec["compile_ms"] == 240.0
+        assert rec["ttfm_ms"] >= 0
+        # ...but the first-step residual charges only the phases
+        # attributed BEFORE the first metric (the post-metric compile is
+        # not part of ttfm).
+        assert rec["first_step_ms"] == pytest.approx(
+            max(0.0, rec["ttfm_ms"] - 375.0), abs=0.2)
+
+    def test_trial_without_broadcast_ships_at_end(self):
+        stats = RunnerStats()
+        stats.trial_start("t1")
+        stats.note_compile(warm=True, init_ms=5.0)
+        stats.trial_end("t1")
+        events = stats.snapshot_delta()["compile_events"]
+        assert len(events) == 1
+        assert "ttfm_ms" not in events[0]
+
+    def test_requeue_restores_compile_events(self):
+        stats = RunnerStats()
+        stats.trial_start("t1")
+        stats.note_compile(warm=True)
+        stats.trial_end("t1")
+        delta = stats.snapshot_delta()
+        assert delta["compile_events"]
+        stats.requeue_delta(delta)
+        again = stats.snapshot_delta()
+        assert again["compile_events"] == delta["compile_events"]
+        assert not stats.snapshot_delta().get("compile_events")
+
+    def test_counters_ship_as_fields(self):
+        stats = RunnerStats()
+        stats.note_counter("warm_hits")
+        stats.note_counter("xla_cache_misses", 2)
+        snap = stats.snapshot()
+        assert snap["warm_hits"] == 1 and snap["xla_cache_misses"] == 2
+
+
+class TestTelemetryMerge:
+    def test_compiled_journaled_once_and_counted(self):
+        from maggy_tpu.telemetry import Telemetry
+
+        telem = Telemetry()
+        rec = {"trial": "t1", "warm": True, "init_ms": 2.0, "ttfm_ms": 5.0}
+        telem.record_runner_stats(0, {"compile_events": [rec]})
+        # Re-delivery (requeued delta racing a successful ship): the
+        # journal keeps ONE compiled event and the counter doesn't double.
+        telem.record_runner_stats(0, {"compile_events": [rec]})
+        events = [e for e in telem.events() if e.get("phase") == "compiled"]
+        assert len(events) == 1
+        assert events[0]["warm"] is True and events[0]["partition"] == 0
+        assert telem.metrics.counter("compile.warm_hits").value == 1
+        assert telem.metrics.counter("compile.warm_misses").value == 0
+
+    def test_counter_fields_become_gauges(self):
+        from maggy_tpu.telemetry import Telemetry
+
+        telem = Telemetry()
+        telem.record_runner_stats(1, {"warm_hits": 3, "xla_cache_hits": 2})
+        snap = telem.metrics.snapshot()
+        assert snap["gauges"]["runner.warm_hits.p1"] == 3
+        assert snap["gauges"]["runner.xla_cache_hits.p1"] == 2
+
+
+class TestStopFlushesPendingStats:
+    """The LAST trial's compile record must not die with the runner: when
+    GSTOP ends the work loop, the pending rstats delta (finalized at trial
+    end, waiting on a heartbeat that will never fire) is flushed by
+    Client.stop as one final idle-shaped beat."""
+
+    def test_client_stop_ships_pending_compile_events(self):
+        from maggy_tpu.core.rpc import Client, OptimizationServer
+        from maggy_tpu.telemetry import Telemetry
+
+        class _Driver:
+            def enqueue(self, msg):
+                pass
+
+            def get_trial(self, trial_id):
+                return None
+
+        telem = Telemetry()
+        server = OptimizationServer(num_executors=1)
+        server.attach_driver(_Driver())
+        server.telemetry = telem
+        addr = server.start()
+        try:
+            client = Client(addr, 0, 0, 10.0, server.secret_hex)
+            stats = RunnerStats()
+            client.runner_stats = stats
+            stats.trial_start("last_trial")
+            stats.note_compile(warm=True, init_ms=3.0)
+            stats.trial_end("last_trial")
+            # No heartbeat thread ever ran: the record is still pending.
+            client.stop()
+        finally:
+            server.stop()
+        events = [e for e in telem.events() if e.get("phase") == "compiled"]
+        assert len(events) == 1 and events[0]["trial"] == "last_trial"
+
+    def test_stop_with_dead_server_does_not_raise(self):
+        from maggy_tpu.core.rpc import Client, OptimizationServer
+
+        server = OptimizationServer(num_executors=1)
+        addr = server.start()
+        client = Client(addr, 0, 0, 10.0, server.secret_hex)
+        stats = RunnerStats()
+        client.runner_stats = stats
+        stats.trial_start("t")
+        stats.note_compile(warm=False, init_ms=1.0)
+        stats.trial_end("t")
+        server.stop()
+        client.stop()  # single attempt fails silently, no retry stall
+
+
+def _compiled_ev(trial, t, warm_flag, ttfm, partition=0, **extra):
+    return {"t": t, "ev": "trial", "trial": trial, "phase": "compiled",
+            "partition": partition, "warm": warm_flag, "ttfm_ms": ttfm,
+            **extra}
+
+
+class TestDeriveCompileBlock:
+    def test_block_shape(self):
+        from maggy_tpu.telemetry import derive
+
+        events = [
+            _compiled_ev("a", 1.0, False, 4000.0, init_ms=1000.0,
+                         trace_ms=300.0, compile_ms=2000.0,
+                         first_step_ms=700.0),
+            _compiled_ev("b", 2.0, True, 30.0, init_ms=2.0,
+                         first_step_ms=28.0),
+            _compiled_ev("c", 3.0, True, 40.0, init_ms=3.0,
+                         first_step_ms=37.0),
+            {"t": 4.0, "ev": "runner_stats", "partition": 0,
+             "xla_cache_hits": 2, "xla_cache_misses": 1},
+            {"t": 5.0, "ev": "runner_stats", "partition": 0,
+             "xla_cache_hits": 5, "xla_cache_misses": 1},
+            {"t": 5.0, "ev": "runner_stats", "partition": 1,
+             "xla_cache_hits": 1, "xla_cache_misses": 4},
+        ]
+        comp = derive(events)["compile"]
+        assert comp["warm_hits"] == 2 and comp["warm_misses"] == 1
+        assert comp["warm_hit_rate"] == pytest.approx(2 / 3, abs=1e-3)
+        assert comp["ttfm_cold"]["median_ms"] == 4000.0
+        assert comp["ttfm_warm"]["median_ms"] == 40.0
+        assert comp["compile_ms"]["n"] == 1
+        # cumulative counters: LAST per partition, summed over partitions
+        assert comp["cache"] == {"hits": 6, "misses": 5,
+                                 "hit_rate": pytest.approx(6 / 11, abs=1e-3)}
+
+    def test_counter_reset_banks_dead_attempt(self):
+        """A replaced runner (chaos kill, pool respawn) restarts its
+        cumulative counters at zero — the dead attempt's totals must stay
+        in the sums, not be erased by the overwrite."""
+        from maggy_tpu.telemetry import derive
+
+        events = [
+            {"t": 1.0, "ev": "runner_stats", "partition": 0,
+             "xla_cache_hits": 7, "xla_cache_misses": 2},
+            # partition 0's process dies; the respawn restarts at zero.
+            {"t": 2.0, "ev": "runner_stats", "partition": 0,
+             "xla_cache_hits": 1, "xla_cache_misses": 1},
+            {"t": 3.0, "ev": "runner_stats", "partition": 0,
+             "xla_cache_hits": 3, "xla_cache_misses": 1},
+        ]
+        comp = derive(events)["compile"]
+        assert comp["cache"]["hits"] == 10  # 7 banked + 3 current
+        assert comp["cache"]["misses"] == 3  # 2 banked + 1 current
+
+    def test_empty_without_warm_data(self):
+        from maggy_tpu.telemetry import derive
+
+        assert derive([{"t": 1.0, "ev": "trial", "trial": "a",
+                        "phase": "queued"}])["compile"] == {}
+
+
+class TestTraceCompileSlices:
+    def test_sub_slices_rendered(self):
+        from maggy_tpu.telemetry.trace import build_trace, validate_trace
+
+        events = [
+            {"t": 10.0, "ev": "trial", "trial": "t1", "phase": "assigned",
+             "partition": 0},
+            {"t": 10.1, "ev": "trial", "trial": "t1", "phase": "running",
+             "partition": 0},
+            _compiled_ev("t1", 10.2, False, 400.0, init_ms=100.0,
+                         trace_ms=50.0, compile_ms=200.0,
+                         first_step_ms=50.0),
+            {"t": 11.0, "ev": "trial", "trial": "t1", "phase": "finalized",
+             "partition": 0},
+        ]
+        trace = build_trace(events)
+        validate_trace(trace)
+        comp = [e for e in trace["traceEvents"] if e.get("cat") == "compile"]
+        names = [e["name"] for e in comp]
+        assert names == ["init (cold)", "trace (cold)", "compile (cold)",
+                         "first_step (cold)"]
+        # sequential layout from the running edge (t=10.1, t0=10.0 ->
+        # 100000 us), widths from the ms durations
+        assert comp[0]["ts"] == 100000
+        assert comp[0]["dur"] == 100000  # init_ms=100.0
+        assert comp[1]["ts"] == comp[0]["ts"] + comp[0]["dur"]
+
+    def test_warm_trial_renders_warm_tag(self):
+        from maggy_tpu.telemetry.trace import build_trace
+
+        events = [
+            {"t": 1.0, "ev": "trial", "trial": "t", "phase": "assigned",
+             "partition": 0},
+            {"t": 1.1, "ev": "trial", "trial": "t", "phase": "running",
+             "partition": 0},
+            _compiled_ev("t", 1.2, True, 30.0, init_ms=2.0,
+                         first_step_ms=28.0),
+        ]
+        comp = [e for e in build_trace(events)["traceEvents"]
+                if e.get("cat") == "compile"]
+        assert [e["name"] for e in comp] == ["init (warm)",
+                                             "first_step (warm)"]
+
+
+class TestEnableCompileCache:
+    """Satellite: util.enable_compile_cache env gating + failure path."""
+
+    def _restore(self):
+        jax.config.update("jax_compilation_cache_dir", None)
+
+    def test_disabled_by_env(self, monkeypatch, tmp_path):
+        from maggy_tpu import util
+
+        monkeypatch.setenv("MAGGY_TPU_NO_COMPILE_CACHE", "1")
+        monkeypatch.setenv("MAGGY_TPU_COMPILE_CACHE_DIR", str(tmp_path))
+        assert util.enable_compile_cache() is None
+
+    def test_cpu_default_off(self, monkeypatch):
+        from maggy_tpu import util
+
+        monkeypatch.delenv("MAGGY_TPU_NO_COMPILE_CACHE", raising=False)
+        monkeypatch.delenv("MAGGY_TPU_COMPILE_CACHE_DIR", raising=False)
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        # XLA:CPU AOT entries embed host ISA features; the cache pays off
+        # on TPU — CPU runs default it off unless explicitly pointed at a
+        # dir.
+        assert util.enable_compile_cache() is None
+
+    def test_dir_override_and_idempotent_recall(self, monkeypatch, tmp_path):
+        from maggy_tpu import util
+
+        monkeypatch.delenv("MAGGY_TPU_NO_COMPILE_CACHE", raising=False)
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setenv("MAGGY_TPU_COMPILE_CACHE_DIR",
+                           str(tmp_path / "xla"))
+        try:
+            first = util.enable_compile_cache()
+            assert first == str(tmp_path / "xla")
+            assert os.path.isdir(first)
+            assert util.enable_compile_cache() == first  # safe to re-call
+            assert jax.config.jax_compilation_cache_dir == first
+        finally:
+            self._restore()
+
+    def test_explicit_dir_beats_cpu_default_off(self, monkeypatch, tmp_path):
+        from maggy_tpu import util
+
+        monkeypatch.delenv("MAGGY_TPU_NO_COMPILE_CACHE", raising=False)
+        monkeypatch.delenv("MAGGY_TPU_COMPILE_CACHE_DIR", raising=False)
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        try:
+            assert util.enable_compile_cache(str(tmp_path / "c")) == \
+                str(tmp_path / "c")
+        finally:
+            self._restore()
+
+    def test_never_fatal(self, monkeypatch, tmp_path):
+        from maggy_tpu import util
+
+        monkeypatch.delenv("MAGGY_TPU_NO_COMPILE_CACHE", raising=False)
+        monkeypatch.setenv("MAGGY_TPU_COMPILE_CACHE_DIR", str(tmp_path))
+
+        def boom(*a, **k):
+            raise RuntimeError("config exploded")
+
+        monkeypatch.setattr(jax.config, "update", boom)
+        assert util.enable_compile_cache() is None  # optimization, not a dep
+
+
+class TestMonitorRendering:
+    def test_render_telem_compile_line(self):
+        from maggy_tpu.monitor import render_telem
+
+        snap = {"enabled": True, "metrics": {}, "journal": {},
+                "spans": {"compile": {
+                    "warm_hits": 5, "warm_misses": 1, "warm_hit_rate": 0.833,
+                    "ttfm_warm": {"median_ms": 30.0, "p95_ms": 40.0, "n": 5},
+                    "ttfm_cold": {"median_ms": 4000.0, "p95_ms": 4000.0,
+                                  "n": 1},
+                    "cache": {"hits": 3, "misses": 1, "hit_rate": 0.75}}}}
+        out = render_telem(snap)
+        assert "compile-once: 5 warm / 1 cold (hit rate 0.833)" in out
+        assert "xla persistent cache: 3 hits / 1 misses" in out
+
+    def test_no_compile_line_without_data(self):
+        from maggy_tpu.monitor import render_telem
+
+        out = render_telem({"enabled": True, "metrics": {}, "journal": {},
+                            "spans": {}})
+        assert "compile-once" not in out
+
+
+# --------------------------------------------------------- end-to-end sweeps
+
+@pytest.fixture
+def local_env(tmp_path):
+    from maggy_tpu.core.environment import EnvSing
+    from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+
+    env = LocalEnv(base_dir=str(tmp_path / "exp"))
+    EnvSing.set_instance(env)
+    yield env
+    EnvSing.reset()
+
+
+def _exp_dir(env):
+    base = env.base_dir
+    return os.path.join(base, sorted(os.listdir(base))[-1])
+
+
+def _save_tree(path, tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    np.savez(path, **{"l{}".format(i): np.asarray(x)
+                      for i, x in enumerate(leaves)})
+
+
+def _load_tree(path, like):
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    data = np.load(path)
+    return jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(data["l{}".format(i)])
+                  for i in range(len(leaves))])
+
+
+def warm_sweep_train_fn(lr, reporter=None):
+    """Repeat-shape trial: same model/mesh/shapes every time, lr swept
+    through the optimizer family — one program for the whole sweep."""
+    tr = make_trainer(lr)
+    tr.init(jax.random.key(0), EXAMPLE)
+    loss = None
+    for i in range(3):
+        batch = tr.place_batch({"inputs": (jnp.asarray(X),),
+                                "labels": jnp.asarray(Y)})
+        loss = tr.step(batch)
+        if reporter is not None:
+            reporter.broadcast(-loss, step=i)
+    return {"metric": -float(loss)}
+
+
+@pytest.mark.perf
+@pytest.mark.timeout(180)
+class TestWarmSweepSmoke:
+    """Satellite CI gate: a 3-trial repeat-shape sweep must journal >= 1
+    warm hit and warm ttfm strictly under cold ttfm (CPU-safe bounds: the
+    cold trial pays a real XLA compile, a warm one only dispatch)."""
+
+    def test_repeat_shape_sweep_journals_warm_hits(self, local_env):
+        from maggy_tpu import OptimizationConfig, Searchspace, experiment
+        from maggy_tpu.telemetry import JOURNAL_NAME, replay_journal
+
+        config = OptimizationConfig(
+            name="warm_smoke", num_trials=3, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE_LOG", [1e-4, 1e-2])),
+            direction="max", num_workers=1, hb_interval=0.05,
+            es_policy="none", seed=0,
+        )
+        experiment.lagom(warm_sweep_train_fn, config)
+        derived = replay_journal(
+            os.path.join(_exp_dir(local_env), JOURNAL_NAME))
+        comp = derived["compile"]
+        assert comp.get("warm_hits", 0) >= 1, comp
+        assert comp["warm_misses"] == 1  # exactly the first trial compiled
+        warm_ttfm = comp["ttfm_warm"]["median_ms"]
+        cold_ttfm = comp["ttfm_cold"]["median_ms"]
+        assert warm_ttfm < cold_ttfm, \
+            "warm ttfm {} not under cold {}".format(warm_ttfm, cold_ttfm)
+
+    def test_warm_start_false_journals_no_warm_hits(self, local_env):
+        from maggy_tpu import OptimizationConfig, Searchspace, experiment
+        from maggy_tpu.telemetry import JOURNAL_NAME, replay_journal
+
+        config = OptimizationConfig(
+            name="legacy_smoke", num_trials=2, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE_LOG", [1e-4, 1e-2])),
+            direction="max", num_workers=1, hb_interval=0.05,
+            es_policy="none", seed=0, warm_start=False,
+        )
+        experiment.lagom(warm_sweep_train_fn, config)
+        derived = replay_journal(
+            os.path.join(_exp_dir(local_env), JOURNAL_NAME))
+        comp = derived["compile"]
+        # Legacy mode still measures (cold ttfm/init attribution) but can
+        # never hit a warm slot.
+        assert comp.get("warm_hits", 0) == 0
+        assert comp.get("warm_misses", 0) == 2
+
+
+def asha_warm_train_fn(lr, budget=1, reporter=None, ctx=None):
+    """ASHA trial on the warm path: a promoted trial RESTORES its parent's
+    final params (checkpoint-forking), so warm-slot reuse must hand it
+    bit-fresh buffers to restore into — any stale-params leak shifts its
+    loss trajectory."""
+    tr = make_trainer(lr)
+    tr.init(jax.random.key(0), EXAMPLE)
+    parent = ctx.parent_trial_id
+    assert ctx.needs_fresh_state == (parent is not None)
+    if parent is not None:
+        tr.variables = _load_tree(
+            os.path.join(ctx.exp_dir, parent, "final_params.npz"),
+            tr.variables)
+    steps = max(1, int(2 * (ctx.budget or 1)))
+    losses = []
+    for i in range(steps):
+        batch = tr.place_batch({"inputs": (jnp.asarray(X),),
+                                "labels": jnp.asarray(Y)})
+        losses.append(float(tr.step(batch)))
+        if reporter is not None:
+            reporter.broadcast(-losses[-1], step=i)
+    _save_tree(os.path.join(ctx.trial_dir, "final_params.npz"),
+               tr.variables)
+    with open(os.path.join(ctx.trial_dir, "warm_record.json"), "w") as f:
+        json.dump({"lr": lr, "parent": parent, "steps": steps,
+                   "losses": losses}, f)
+    return {"metric": -losses[-1]}
+
+
+@pytest.mark.chaos
+@pytest.mark.timeout(240)
+class TestWarmNeverLeaksAcrossDispatch:
+    """Satellite: ASHA re-dispatch and preemption resume onto a WARM
+    runner must produce step-for-step the same losses as a cold runner."""
+
+    def _cold_losses(self, lr, steps, start_params_path=None):
+        tr = make_trainer(lr, warm_start=False)
+        tr.init(jax.random.key(0), EXAMPLE)
+        if start_params_path is not None:
+            tr.variables = _load_tree(start_params_path, tr.variables)
+        losses = []
+        for _ in range(steps):
+            batch = tr.place_batch({"inputs": (jnp.asarray(X),),
+                                    "labels": jnp.asarray(Y)})
+            losses.append(float(tr.step(batch)))
+        return losses
+
+    def test_asha_promotions_on_warm_runner_match_cold(self, local_env):
+        from maggy_tpu import OptimizationConfig, Searchspace, experiment
+        from maggy_tpu.optimizers.asha import Asha
+
+        config = OptimizationConfig(
+            name="asha_warm", num_trials=6,
+            optimizer=Asha(reduction_factor=2, resource_min=1,
+                           resource_max=4),
+            searchspace=Searchspace(lr=("DOUBLE", [1e-4, 5e-3])),
+            direction="max", num_workers=1, hb_interval=0.05, seed=3,
+            es_policy="none",
+        )
+        experiment.lagom(asha_warm_train_fn, config)
+        exp_dir = _exp_dir(local_env)
+        records = {}
+        for path in glob.glob(os.path.join(exp_dir, "*",
+                                           "warm_record.json")):
+            with open(path) as f:
+                records[os.path.basename(os.path.dirname(path))] = \
+                    json.load(f)
+        assert any(r["parent"] for r in records.values()), \
+            "no promotion happened; the scenario was not exercised"
+        for trial_id, rec in records.items():
+            start = None
+            if rec["parent"]:
+                start = os.path.join(exp_dir, rec["parent"],
+                                     "final_params.npz")
+            cold = self._cold_losses(rec["lr"], rec["steps"],
+                                     start_params_path=start)
+            assert rec["losses"] == cold, \
+                "trial {} diverged from cold run".format(trial_id)
+
+    def test_preempt_resume_on_warm_runner_matches_cold(self, local_env,
+                                                        tmp_path):
+        from maggy_tpu.chaos.harness import preempt_plan, run_soak
+
+        def preempt_warm_train_fn(lr, units, reporter=None, ctx=None):
+            import time as _time
+
+            adam_lr = max(float(lr), 1e-4)
+            tr = make_trainer(adam_lr)
+            tr.init(jax.random.key(0), EXAMPLE)
+            start = 0
+            if ctx is not None and ctx.resume_step is not None:
+                # Full state (params AND optimizer moments): a resume must
+                # continue the trajectory exactly, not restart adam.
+                tr.variables, tr.opt_state = _load_tree(
+                    os.path.join(ctx.trial_dir, "checkpoints",
+                                 str(ctx.resume_step), "state.npz"),
+                    (tr.variables, tr.opt_state))
+                start = ctx.resume_step + 1
+            for step in range(start, 6):
+                batch = tr.place_batch({"inputs": (jnp.asarray(X),),
+                                        "labels": jnp.asarray(Y)})
+                loss = float(tr.step(batch))
+                step_dir = os.path.join(ctx.trial_dir, "checkpoints",
+                                        str(step))
+                os.makedirs(step_dir, exist_ok=True)
+                _save_tree(os.path.join(step_dir, "state.npz"),
+                           (tr.variables, tr.opt_state))
+                with open(os.path.join(ctx.trial_dir, "losses.jsonl"),
+                          "a") as f:
+                    f.write(json.dumps({"step": step, "loss": loss,
+                                        "lr": adam_lr}) + "\n")
+                _time.sleep(0.04)
+                if reporter is not None:
+                    reporter.broadcast(-loss, step=step)
+            return {"metric": -loss}
+
+        report = run_soak(plan=preempt_plan(seed=7, nth=2),
+                          train_fn=preempt_warm_train_fn, num_trials=4,
+                          workers=2, hb_interval=0.05,
+                          hb_loss_timeout=30.0,
+                          base_dir=str(tmp_path / "soak"))
+        assert report["ok"], report["violations"]
+        resumed = [p for p in report["preemptions"]
+                   if p.get("outcome") == "preempted"
+                   and p.get("checkpointed")]
+        assert resumed, "no checkpointed preemption; scenario not exercised"
+        exp_dir = os.path.dirname(report["journal"])
+        for losses_path in glob.glob(os.path.join(exp_dir, "*",
+                                                  "losses.jsonl")):
+            by_step = {}
+            lr = None
+            with open(losses_path) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    assert rec["step"] not in by_step, \
+                        "step {} re-ran after resume".format(rec["step"])
+                    by_step[rec["step"]] = rec["loss"]
+                    lr = rec["lr"]
+            assert sorted(by_step) == list(range(6))
+            cold = self._cold_losses(lr, 6)
+            got = [by_step[i] for i in range(6)]
+            assert got == cold, \
+                "{} diverged from cold run".format(losses_path)
