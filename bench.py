@@ -310,6 +310,34 @@ def scheduling_telemetry(exp_dir, trial_dicts):
             "source": "trial_json_fallback"}
 
 
+def analysis_detail(witness=None):
+    """``detail.analysis``: the static-analysis posture of the package
+    this bench ran against — finding/suppression counts per checker, the
+    lock inventory, and (when a soak ran under the lock-order witness)
+    the dynamically observed edge count. Recorded in every BENCH_*.json
+    so concurrency-discipline drift shows up in the trajectory next to
+    the perf numbers (a new suppression or a findings spike is visible
+    without re-running the analyzer against an old checkout)."""
+    try:
+        from maggy_tpu.analysis import run_analysis
+
+        report = run_analysis()
+        out = {
+            "findings": len(report["findings"]),
+            "per_checker": report["summary"],
+            "suppressed": len(report["suppressed"]),
+            "locks": report["num_locks"],
+            "order_edges": len(report.get("lock_edges", [])),
+        }
+    except Exception as e:  # noqa: BLE001 - posture is best-effort here;
+        # the tier-1 conformance test is the enforcement point
+        out = {"error": repr(e)}
+    if witness:
+        out["witness_edges"] = witness.get("edge_count")
+        out["witness_violations"] = len(witness.get("violations") or [])
+    return out
+
+
 # ------------------------------------------------------------- MFU + kernels
 
 # Peak bf16 matmul throughput per chip, by device_kind prefix.
@@ -665,6 +693,7 @@ def headline_main():
             "compile_ab": compile_ab,
             "handoff_source": sched["source"],
             "trace": trace_path,
+            "analysis": analysis_detail(),
         },
     }), flush=True)
     return 0
@@ -709,7 +738,8 @@ def chaos_main():
     t0 = time.time()
     report = run_soak(seed=seed,
                       num_trials=int(os.environ.get("BENCH_CHAOS_TRIALS",
-                                                    "12")))
+                                                    "12")),
+                      lock_witness=True)
     print(json.dumps({
         "metric": "chaos soak (kill+preempt+drop+sever, journal-checked)",
         "value": 1.0 if report["ok"] else 0.0,
@@ -728,6 +758,10 @@ def chaos_main():
             # instant markers): validated perfetto-loadable or None.
             "trace": _export_trace_artifact(
                 os.path.dirname(report["journal"])),
+            # Static posture + the witness edges this soak observed: the
+            # soak doubles as a dynamic race check (run_soak fails on any
+            # forbidden edge, so a green soak certifies zero).
+            "analysis": analysis_detail(report.get("witness")),
         },
     }), flush=True)
     return 0 if report["ok"] else 1

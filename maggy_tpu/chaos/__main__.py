@@ -31,6 +31,13 @@ duplicate FINAL, no double requeue.
 ``--show-schedule`` prints the plan's deterministic decision expansion
 (the fingerprint): run it twice with the same seed and diff the output to
 see the same-plan-same-schedule guarantee directly.
+
+Every soak additionally runs under the lock-order witness
+(maggy_tpu.analysis.witness) unless ``--no-witness``: the acquisition
+edges the experiment actually takes are checked against the static
+canonical lock order (docs/analysis.md), and any forbidden edge is
+reported alongside the invariant violations — an invariant run doubles
+as a dynamic race check.
 """
 
 from __future__ import annotations
@@ -79,6 +86,12 @@ def main(argv=None) -> int:
     ap.add_argument("--show-schedule", action="store_true",
                     help="print the plan's deterministic decision "
                          "expansion and exit (no experiment)")
+    ap.add_argument("--no-witness", action="store_true",
+                    help="disable the runtime lock-order witness "
+                         "(maggy_tpu.analysis.witness; on by default so "
+                         "every soak doubles as a dynamic race check — "
+                         "forbidden acquisition edges are reported "
+                         "alongside invariant violations)")
     args = ap.parse_args(argv)
 
     from maggy_tpu.chaos import harness
@@ -137,7 +150,7 @@ def main(argv=None) -> int:
     report = harness.run_soak(
         plan=plan, seed=plan.seed, train_fn=train_fn,
         num_trials=args.trials, workers=args.workers, pool=args.pool,
-        **soak_kwargs)
+        lock_witness=not args.no_witness, **soak_kwargs)
     print(json.dumps(report, indent=2, default=str))
     return 0 if report["ok"] else 1
 

@@ -175,7 +175,8 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
              hb_interval: float = 0.05, hb_loss_timeout: float = 0.6,
              base_dir: Optional[str] = None,
              requeue_grace_s: float = 5.0,
-             config_overrides: Optional[Dict[str, Any]] = None
+             config_overrides: Optional[Dict[str, Any]] = None,
+             lock_witness: Optional[bool] = None
              ) -> Dict[str, Any]:
     """Execute one soak and return its report (see ``check_invariants``).
 
@@ -184,8 +185,28 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
     derived from the same artifact an offline replay would use.
     ``config_overrides`` merges extra OptimizationConfig fields (e.g.
     ``health_hang_factor`` to tighten the hang watchdog for a stall
-    soak)."""
+    soak).
+
+    ``lock_witness`` arms the runtime lock-order witness
+    (maggy_tpu.analysis.witness) for the soak, so the invariant run
+    doubles as a dynamic race check: every acquired-while-holding edge
+    the experiment actually takes is recorded, and any edge the static
+    canonical order forbids is reported alongside the invariant
+    violations. ``None`` defers to MAGGY_TPU_LOCK_WITNESS (the chaos
+    CLI passes True by default). Installation happens before the driver
+    builds its locks; if this call installed the witness (rather than
+    finding it already active), it uninstalls on the way out."""
     import tempfile
+
+    from maggy_tpu.analysis import witness as _witness
+
+    wit = None
+    wit_installed_here = False
+    wit_pre_violations = 0
+    if lock_witness or (lock_witness is None and _witness.enabled_by_env()):
+        wit_installed_here = _witness.active_witness() is None
+        wit = _witness.install()
+        wit_pre_violations = len(wit.violations)
 
     from maggy_tpu import OptimizationConfig, Searchspace, experiment
     from maggy_tpu.core import rpc
@@ -225,7 +246,12 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
         # vacuous, not violated.
         stall_flag_bound_s = None
     retry0 = rpc.CLIENT_METRICS.counter("rpc.client.retries").value
-    result = experiment.lagom(train_fn, config)
+    try:
+        result = experiment.lagom(train_fn, config)
+    finally:
+        if wit is not None and wit_installed_here \
+                and not _witness.enabled_by_env():
+            _witness.uninstall()
     retries = rpc.CLIENT_METRICS.counter("rpc.client.retries").value - retry0
     exp_dirs = sorted(d for d in glob.glob(os.path.join(base_dir, "*"))
                       if os.path.isdir(d))
@@ -266,6 +292,18 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
         client_retries=retries,
         schedule_fingerprint=plan.fingerprint(),
     )
+    if wit is not None:
+        # Witness violations count from this soak's install point, so a
+        # shared (env-armed, multi-soak) witness doesn't re-report an
+        # earlier soak's edges as this soak's failure.
+        snap = wit.snapshot()
+        new = snap["violations"][wit_pre_violations:]
+        report["witness"] = {"edge_count": snap["edge_count"],
+                             "violations": new}
+        if new:
+            report["violations"].extend(
+                "lock-order witness: " + v for v in new)
+            report["ok"] = False
     return report
 
 

@@ -124,14 +124,14 @@ class ChaosEngine:
         # Cooperative (thread-pool) fault state, consulted by the client
         # hook: condemned partitions die on their next request; stalled
         # ones sleep until the deadline.
-        self._condemned: set = set()
-        self._stalled_until: Dict[int, float] = {}
+        self._condemned: set = set()  # guarded-by: _lock
+        self._stalled_until: Dict[int, float] = {}  # guarded-by: _lock
         # Partitions under an ACTIVE fake preemption (pid -> mute
         # deadline): the driver's loss-reap must not SIGKILL them — the
         # whole point of the fault is a HEALTHY runner declared lost
         # (the duplicate-FINAL race), and reaping would degrade it into
         # a plain kill on process pools.
-        self._preempted: Dict[int, float] = {}
+        self._preempted: Dict[int, float] = {}  # guarded-by: _lock
         #: Injection log: [{"kind", "t", ...}] — the in-memory mirror of
         #: the journaled chaos events (tests assert on it without a
         #: journal round-trip).

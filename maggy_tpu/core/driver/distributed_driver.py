@@ -24,9 +24,9 @@ class DistributedDriver(Driver):
         self.num_workers = config.num_workers
         self.num_executors = config.num_workers  # RemoteRunnerPool contract
         super().__init__(config, app_id, run_id)
-        self.results: List[float] = []
-        self._finals = 0
-        self._worker_errors = 0
+        self.results: List[float] = []  # guarded-by: _results_lock
+        self._finals = 0  # guarded-by: _results_lock
+        self._worker_errors = 0  # guarded-by: _results_lock
         self._results_lock = threading.Lock()
         self.job_start = None
         # A silent SPMD worker deadlocks the whole world's collectives —
@@ -135,7 +135,8 @@ class DistributedDriver(Driver):
                                   self.exp_dir))
         with self._results_lock:
             avg = sum(self.results) / len(self.results) if self.results else None
-        result = {"average_metric": avg, "per_worker": list(self.results),
+            per_worker = list(self.results)
+        result = {"average_metric": avg, "per_worker": per_worker,
                   "num_workers": self.num_workers,
                   "duration_s": job_end - (self.job_start or job_end)}
         self.env.dump(json.dumps(result, indent=2), self.exp_dir + "/result.json")

@@ -47,7 +47,7 @@ class Driver(ABC):
         self.worker_done = False
         self.experiment_done = False
         self._worker_thread: Optional[threading.Thread] = None
-        self.executor_logs: list = []
+        self.executor_logs: list = []  # guarded-by: _log_lock
         self._log_lock = threading.Lock()
         self.exception: Optional[BaseException] = None
 

@@ -80,24 +80,27 @@ class OptimizationDriver(Driver):
         super().__init__(config, app_id, run_id)
 
         # Trial bookkeeping shared with the server thread.
-        self._trial_store: Dict[str, Trial] = {}
-        self._final_store: List[Trial] = []
+        self._trial_store: Dict[str, Trial] = {}  # guarded-by: _store_lock
+        self._final_store: List[Trial] = []  # guarded-by: _store_lock
         self._store_lock = threading.RLock()
         # Trials orphaned by a lost runner, waiting for reassignment. Served
-        # by _assign_next ahead of fresh controller suggestions.
-        self._requeue: List[str] = []
+        # by _assign_next ahead of fresh controller suggestions. Guarded by
+        # the STORE lock, not _sched_lock: the LOST/BLACK callbacks and the
+        # server event loop (periodic_check) touch the backlog without ever
+        # taking the schedule lock.
+        self._requeue: List[str] = []  # guarded-by: _store_lock
         # Trials parked for a runner of the RIGHT chip capacity (elastic
         # pools): the schedule already committed to them, but the runner
         # that triggered the suggestion is pinned to a different size.
-        self._parked: List[str] = []
+        self._parked: List[str] = []  # guarded-by: _store_lock
         self._chips_map = getattr(config, "chips_per_budget", None)
         # Outstanding resize requests by target size: bounds the idle-runner
         # migration so a herd of idle runners doesn't all chase one parked
         # trial's size (decremented when a runner REGisters at that size).
-        self._resize_inflight: Dict[int, int] = {}
+        self._resize_inflight: Dict[int, int] = {}  # guarded-by: _store_lock
         # partition_id -> (monotonic request time, target chips): liveness
         # watch on resize respawns (see periodic_check).
-        self._resize_watch: Dict[int, tuple] = {}
+        self._resize_watch: Dict[int, tuple] = {}  # guarded-by: _store_lock
         # Arm heartbeat-loss detection (SURVEY.md §5.3): a silent runner's
         # trial is requeued to whichever runner asks for work next. The
         # loss shape (floor + interval multiple) is per-experiment config
@@ -146,13 +149,13 @@ class OptimizationDriver(Driver):
         # the controller's schedule_version at suggest time; a FINAL that
         # bumps the version invalidates the stale entries before dispatch.
         # Both guarded by _sched_lock.
-        self._prefetched: List[Trial] = []
-        self._prefetch_versions: Dict[str, int] = {}
+        self._prefetched: List[Trial] = []  # guarded-by: _sched_lock
+        self._prefetch_versions: Dict[str, int] = {}  # guarded-by: _sched_lock
         self._suggest_wake = threading.Event()
         # >0 while the FINAL fast path is executing on the RPC dispatch
         # thread (mutated under _sched_lock): an expensive suggest() must
         # fall back to the suggester instead of fitting on the event loop.
-        self._inline_depth = 0
+        self._inline_depth = 0  # guarded-by: _sched_lock
         self._suggester_thread: Optional[threading.Thread] = None
 
         if getattr(config, "resume", False):
@@ -367,8 +370,10 @@ class OptimizationDriver(Driver):
         with self._store_lock:
             n_final = len(self._final_store)
         if n_final >= self.es_min and n_steps % self.es_interval == 0:
+            with self._store_lock:
+                final_snapshot = list(self._final_store)
             stopped = self.earlystop_check.earlystop_check(
-                {trial.trial_id: trial}, list(self._final_store), self.direction
+                {trial.trial_id: trial}, final_snapshot, self.direction
             )
             for t in stopped:
                 # The rule can re-return an already-flagged trial (its
@@ -665,6 +670,7 @@ class OptimizationDriver(Driver):
         self.telemetry.trial_event(suggestion.trial_id, "suggested")
         return suggestion
 
+    # locked-by: _sched_lock
     def _admit_prefetched(self, trial: Trial) -> None:
         """Commit a prefetched suggestion (sched lock held): it enters the
         trial store NOW, so controller capacity checks — BO busy-location
@@ -683,6 +689,7 @@ class OptimizationDriver(Driver):
         self._prefetch_versions[trial.trial_id] = getattr(
             self.controller, "schedule_version", 0)
 
+    # locked-by: _sched_lock
     def _invalidate_stale_prefetch(self) -> None:
         """Drop prefetched suggestions minted before the controller's
         current schedule_version (sched lock held): a FINAL that changed
@@ -707,6 +714,7 @@ class OptimizationDriver(Driver):
         self.telemetry.metrics.counter("prefetch.invalidated").inc(len(stale))
         self._suggest_wake.set()
 
+    # locked-by: _sched_lock
     def _ingest_final_report(self, last_trial: Trial) -> None:
         """The FINAL-path half of the split controller contract (sched
         lock held): rung/pruner/member bookkeeping, then stale-prefetch
@@ -714,6 +722,7 @@ class OptimizationDriver(Driver):
         self.controller.report(last_trial)
         self._invalidate_stale_prefetch()
 
+    # locked-by: _sched_lock
     def _next_suggestion(self):
         """Controller-sourced candidate for a hand-off (sched lock held):
         the oldest still-valid prefetched suggestion when available, else

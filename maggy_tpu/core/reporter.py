@@ -21,9 +21,9 @@ from maggy_tpu import exceptions
 class Reporter:
     def __init__(self, log_file: Optional[str] = None, print_tee: bool = False):
         self.lock = threading.RLock()
-        self.metric: Optional[float] = None
-        self.step: Optional[int] = None
-        self.trial_id: Optional[str] = None
+        self.metric: Optional[float] = None  # guarded-by: lock
+        self.step: Optional[int] = None  # guarded-by: lock
+        self.trial_id: Optional[str] = None  # guarded-by: lock
         # Telemetry span id assigned by the driver for this trial; rides
         # the TRIAL reply and is echoed on METRIC/FINAL so driver-side
         # span timelines attribute every hop without guessing.
@@ -32,16 +32,16 @@ class Reporter:
         # attached by the executor: broadcast() feeds it the step cadence
         # and time-to-first-metric signals. None = no-op.
         self.stats = None
-        self._stop_flag = False
+        self._stop_flag = False  # guarded-by: lock
         # The current stop is a scheduler preemption (STOP reply carried
         # ``preempt``): the executor acks with a preempted FINAL instead
         # of finalizing. Consumed via take_preempt().
-        self._preempt_flag = False
-        self._log_buffer: List[str] = []
+        self._preempt_flag = False  # guarded-by: lock
+        self._log_buffer: List[str] = []  # guarded-by: lock
         self._log_file = log_file
         self._print_tee = print_tee
-        self._metric_cache = None  # (device_array, float, step) identity triple
-        self._async_kick = None  # device array with an in-flight D2H copy
+        self._metric_cache = None  # guarded-by: lock  # (device_array, float, step) identity triple
+        self._async_kick = None  # guarded-by: lock  # device array with an in-flight D2H copy
 
     # ------------------------------------------------------------- user API
 
@@ -150,11 +150,22 @@ class Reporter:
             else:
                 try:
                     ready = metric.is_ready()
-                    if not ready and self._async_kick is not metric:
-                        metric.copy_to_host_async()
-                        self._async_kick = metric
                 except AttributeError:  # 0-d numpy etc.: materialize now
                     ready = True
+                if not ready:
+                    # Kick bookkeeping under the lock, with the same
+                    # rolled-over guard as the cache below: reset()
+                    # clears _async_kick when the trial rolls over, and
+                    # an unlocked write landing after it would resurrect
+                    # the RETIRED trial's device array as the next
+                    # trial's in-flight kick (found by the guarded-by
+                    # checker: every other _async_kick write holds the
+                    # lock). copy_to_host_async is non-blocking.
+                    with self.lock:
+                        if self._async_kick is not metric \
+                                and self.trial_id == tid:
+                            metric.copy_to_host_async()
+                            self._async_kick = metric
                 if ready:
                     value = self._materialize(metric)
                     with self.lock:

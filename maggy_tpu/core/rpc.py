@@ -109,11 +109,11 @@ class Reservations:
     def __init__(self, required: int):
         self.required = required
         self.lock = threading.RLock()
-        self._table: Dict[int, Dict[str, Any]] = {}
+        self._table: Dict[int, Dict[str, Any]] = {}  # guarded-by: lock
         # Evictions requested before the partition registered (fleet
         # preemption racing a fresh lease's REG): applied at add() so the
         # release is delivered instead of silently lost.
-        self._pending_evict: set = set()
+        self._pending_evict: set = set()  # guarded-by: lock
 
     def add(self, meta: Dict[str, Any]) -> None:
         with self.lock:
@@ -150,6 +150,7 @@ class Reservations:
                 if mute_s > 0:
                     rec["mute_until"] = now + mute_s
 
+    # locked-by: lock
     def _silent_locked(self, timeout: float):
         now = time.monotonic()
         return [
@@ -328,7 +329,7 @@ class Server:
         # fresher than the liveness bound or its holder has registered; an
         # issued-but-never-registered slot expires and becomes reclaimable
         # (the joining agent died before REG).
-        self._issued_pids: Dict[int, float] = {}
+        self._issued_pids: Dict[int, float] = {}  # guarded-by: _join_lock
         # Heartbeat-liveness bound used by JOIN slot-reclaim checks (and, in
         # OptimizationServer, the loss scan). None disables.
         self.hb_loss_timeout: Optional[float] = None
@@ -352,6 +353,7 @@ class Server:
             "done": self.reservations.done(),
         }
         self._handlers["JOIN"] = self._join
+        # rpc-ok: TELEM produced by monitor --telem via a generic send_msg
         self._handlers["TELEM"] = self._telem
 
     def _telem(self, msg):
@@ -641,7 +643,7 @@ class SharedServer:
 
     def __init__(self):
         self._lock = threading.RLock()
-        self._servers: Dict[bytes, Server] = {}
+        self._servers: Dict[bytes, Server] = {}  # guarded-by: _lock
         self._conn_server: Dict[socket.socket, Server] = {}
         self._buffers: Dict[socket.socket, bytearray] = {}
         self._sel = selectors.DefaultSelector()
@@ -1436,10 +1438,13 @@ class Client:
         want to inspect it."""
         with reporter.lock:
             data = reporter.get_data()
+            # No "span" key: the driver attributes FINALs through the span
+            # tracker by trial id (spans are per trial, not per attempt),
+            # so a span echo here was dead payload — the rpcconf checker
+            # flags any key no handler reads.
             resp = self._request(
                 {"type": "FINAL", "trial_id": reporter.trial_id,
-                 "value": metric, "logs": data["logs"],
-                 "span": data.get("span"), **(extra or {})}
+                 "value": metric, "logs": data["logs"], **(extra or {})}
             )
             reporter.reset()
         self._handle_final_reply(resp)
@@ -1454,8 +1459,7 @@ class Client:
             data = reporter.get_data()
             resp = self._request(
                 {"type": "FINAL", "trial_id": trial_id, "value": None,
-                 "error": True, "logs": data["logs"],
-                 "span": data.get("span")}
+                 "error": True, "logs": data["logs"]}
             )
             reporter.reset()
         self._handle_final_reply(resp)
@@ -1475,7 +1479,7 @@ class Client:
                 {"type": "FINAL", "trial_id": trial_id, "value": None,
                  "preempted": True,
                  "step": int(step) if step is not None else None,
-                 "logs": data["logs"], "span": data.get("span")}
+                 "logs": data["logs"]}
             )
             reporter.reset()
         self._handle_final_reply(resp)

@@ -364,7 +364,7 @@ class ElasticTPURunnerPool(RunnerPool):
         # expire the watch and reclaim the in-flight credit). spawn_stamp()
         # returns None for BOTH, which is exactly the ambiguity that leaked
         # credits before.
-        self._pending_respawns: list = []
+        self._pending_respawns: list = []  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def spawn_stamp(self, partition_id: int):

@@ -109,9 +109,10 @@ class FleetPolicy:
                 "max_runners": self.max_runners}
 
 
-class ExperimentEntry:
+class ExperimentEntry:  # guarded-by: FleetScheduler._lock
     """One submitted experiment's scheduling state. All mutable fields are
-    guarded by the scheduler's lock."""
+    guarded by the scheduler's lock (class-line annotation: the guards
+    checker treats the whole class as externally synchronized)."""
 
     def __init__(self, name: str, policy: FleetPolicy, seq: int):
         self.name = name
@@ -189,11 +190,11 @@ class FleetScheduler:
         self.preempt_grace_s = float(preempt_grace_s)
         self._lock = threading.RLock()
         self._wake = threading.Condition(self._lock)
-        self._entries: Dict[str, ExperimentEntry] = {}
+        self._entries: Dict[str, ExperimentEntry] = {}  # guarded-by: _lock
         # Final snapshots of completed experiments (bounded): finished
         # entries leave _entries so scheduling decisions stay O(live)
         # and a long-lived fleet host doesn't grow without bound.
-        self._finished: List[Dict[str, Any]] = []
+        self._finished: List[Dict[str, Any]] = []  # guarded-by: _lock
         self._seq = itertools.count()
         self.stopped = False
 
@@ -212,6 +213,7 @@ class FleetScheduler:
             self._wake.notify_all()
         return entry
 
+    # locked-by: _lock
     def _admit_locked(self) -> None:
         active = sum(1 for e in self._entries.values()
                      if e.state == "active")
@@ -265,6 +267,7 @@ class FleetScheduler:
 
     # -------------------------------------------------------------- targets
 
+    # locked-by: _lock
     def _targets_locked(self) -> Dict[str, int]:
         """Per-experiment runner target: min_runners first in priority
         order, then leftover capacity waterfilled class by class with a
@@ -343,6 +346,7 @@ class FleetScheduler:
                     return None
                 self._wake.wait(timeout=0.2)
 
+    # locked-by: _lock
     def _pick_locked(self) -> Optional[ExperimentEntry]:
         targets = self._targets_locked()
         now = time.monotonic()
@@ -462,6 +466,7 @@ class FleetScheduler:
                         trial=trial, for_exp=starving.name)
         return fired
 
+    # locked-by: _lock
     def _victim_locked(self, starving: ExperimentEntry,
                        targets: Dict[str, int]
                        ) -> Optional[ExperimentEntry]:
@@ -659,8 +664,8 @@ class Fleet:
         self._started = False
         self._stopped = False
         self._lock = threading.Lock()
-        self._submissions: Dict[str, FleetSubmission] = {}
-        self._sub_threads: List[threading.Thread] = []
+        self._submissions: Dict[str, FleetSubmission] = {}  # guarded-by: _lock
+        self._sub_threads: List[threading.Thread] = []  # guarded-by: _lock
         self._sub_seq = itertools.count()
 
     # ------------------------------------------------------------ lifecycle
@@ -718,9 +723,10 @@ class Fleet:
             if self._stopped:
                 return
             self._stopped = True
+            subs = list(self._sub_threads)
         if wait:
             deadline = time.monotonic() + timeout
-            for t in list(self._sub_threads):
+            for t in subs:
                 t.join(timeout=max(0.1, deadline - time.monotonic()))
         self.scheduler.stop()
         for t in (self._pool_thread, self._tick_thread):

@@ -98,6 +98,18 @@ def _ungroup_kv(x):
     return x.transpose(0, 2, 1, 3)
 
 
+def _tpu_compiler_params(**kwargs):
+    """Version-guarded Pallas TPU CompilerParams: the class was renamed
+    ``TPUCompilerParams`` -> ``CompilerParams`` across JAX releases, and
+    kernel construction must not assume either spelling (the lone tier-1
+    failure this guard fixes was exactly that assumption)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
+
 def _causal_tile_mask(s, qi, kb, blk_q, blk_k, offset):
     """Bottom-right-aligned causal mask for one [blk_q, blk_k] tile:
     query row p attends key col c iff c <= p + offset (offset = Sk - Sq)."""
@@ -229,7 +241,7 @@ def _flash_fwd(qg, kg, vg, mask, causal, blk_q, blk_k, interpret):
             pltpu.VMEM((blk_q, 128), jnp.float32),
             pltpu.VMEM((blk_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             # b/g/r/qi programs are independent (megacore-splittable); the
             # k-block dimension carries the online-softmax accumulation and
             # must run sequentially.
@@ -447,7 +459,7 @@ def _flash_bwd(qg, kg, vg, dog, lse, delta, mask, causal, blk_q, blk_k,
             pltpu.VMEM((blk_k, D), jnp.float32),
             pltpu.VMEM((blk_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary", "arbitrary")),
         interpret=interpret,
@@ -475,7 +487,7 @@ def _flash_bwd(qg, kg, vg, dog, lse, delta, mask, causal, blk_q, blk_k,
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((B, G, R, Sq, D), qg.dtype)],
         scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "parallel", "arbitrary")),
         interpret=interpret,

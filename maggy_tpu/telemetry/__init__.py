@@ -56,7 +56,7 @@ class Telemetry:
         # Journal-less fallback buffer (no env/path given): spans still
         # derive for the TELEM verb, just without persistence.
         self._local_lock = threading.Lock()
-        self._local_events: List[Dict[str, Any]] = []
+        self._local_events: List[Dict[str, Any]] = []  # guarded-by: _local_lock
         # snapshot() runs on the RPC event loop; derive() is O(events), so
         # cache it: (monotonic t, event count, derived). Recomputed only
         # when events arrived AND the cache is older than a second —
@@ -75,8 +75,8 @@ class Telemetry:
         # heartbeat METRIC payloads), merged per partition, plus the
         # per-partition trial-progress stamps the hang watchdog reads.
         self._runner_lock = threading.Lock()
-        self._runner_state: Dict[int, Dict[str, Any]] = {}
-        self._progress: Dict[int, float] = {}
+        self._runner_state: Dict[int, Dict[str, Any]] = {}  # guarded-by: _runner_lock
+        self._progress: Dict[int, float] = {}  # guarded-by: _runner_lock
         # Trials whose compiled record already bumped the live registry
         # counters (the journal itself is deduped by once=True).
         self._compiled_seen: set = set()

@@ -126,11 +126,11 @@ class HealthEngine:
         self.reservations = None
         self._lock = threading.Lock()
         #: (check, metric, partition) -> active flag dict.
-        self._active: Dict[tuple, Dict[str, Any]] = {}
-        self.raised_total = 0
-        self.checks_run = 0
-        self._last_check_t: Optional[float] = None
-        self._check_failed = False
+        self._active: Dict[tuple, Dict[str, Any]] = {}  # guarded-by: _lock
+        self.raised_total = 0  # guarded-by: _lock
+        self.checks_run = 0  # guarded-by: _lock
+        self._last_check_t: Optional[float] = None  # guarded-by: _lock
+        self._check_failed = False  # unguarded-ok: engine-loop-private latch, single writer thread
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

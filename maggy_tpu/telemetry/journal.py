@@ -40,17 +40,17 @@ class TelemetryJournal:
         # append it twice — duplicated events break replay's
         # same-journal-same-numbers contract.
         self._flush_lock = threading.Lock()
-        self._events: List[Dict[str, Any]] = []
+        self._events: List[Dict[str, Any]] = []  # guarded-by: _lock
         # How many leading events are already on disk. 0 forces the first
         # flush to be a full rewrite (truncates a stale journal from an
         # unrelated earlier run at the same path); afterwards flushes
         # append only events[_flushed:].
-        self._flushed = 0
+        self._flushed = 0  # guarded-by: _lock
         # None = untried, False = backend rejected append mode (object
         # stores): every flush falls back to the full atomic rewrite.
-        self._append_ok: Optional[bool] = None
-        self._dirty = False
-        self._closed = False
+        self._append_ok: Optional[bool] = None  # guarded-by: _flush_lock
+        self._dirty = False  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
         #: Corrupt/torn lines skipped when loading a previous run's journal
         #: (load_existing). Exposed in the TELEM snapshot so journal
         #: corruption is visible instead of quietly shrinking the dataset.
@@ -108,6 +108,7 @@ class TelemetryJournal:
         with self._flush_lock:
             self._flush_locked()
 
+    # locked-by: _flush_lock
     def _flush_locked(self) -> None:
         with self._lock:
             if not self._dirty:

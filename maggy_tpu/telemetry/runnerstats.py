@@ -115,30 +115,30 @@ class RunnerStats:
     def __init__(self, mem_interval_s: float = 2.0):
         self._lock = threading.Lock()
         self.mem_interval_s = mem_interval_s
-        self._trial_id: Optional[str] = None
-        self._trial_t0: Optional[float] = None   # monotonic train start
-        self._last_broadcast: Optional[float] = None
-        self._steps = 0              # broadcasts within the current trial
-        self._trials_done = 0
-        self._cadence_ms: Optional[float] = None
-        self._ttfm_ms: Optional[float] = None
-        self._hb_rtt_ms: Optional[float] = None
-        self._rss_mb: Optional[float] = None
-        self._dev_mem_mb: Optional[float] = None
-        self._last_mem_sample = 0.0
-        self._profile_skipped: List[str] = []
-        self._last_shipped: Dict[str, Any] = {}
+        self._trial_id: Optional[str] = None  # guarded-by: _lock
+        self._trial_t0: Optional[float] = None  # guarded-by: _lock # monotonic train start
+        self._last_broadcast: Optional[float] = None  # guarded-by: _lock
+        self._steps = 0  # guarded-by: _lock # broadcasts within the current trial
+        self._trials_done = 0  # guarded-by: _lock
+        self._cadence_ms: Optional[float] = None  # guarded-by: _lock
+        self._ttfm_ms: Optional[float] = None  # guarded-by: _lock
+        self._hb_rtt_ms: Optional[float] = None  # guarded-by: _lock
+        self._rss_mb: Optional[float] = None  # guarded-by: _lock
+        self._dev_mem_mb: Optional[float] = None  # guarded-by: _lock
+        self._last_mem_sample = 0.0  # guarded-by: _lock
+        self._profile_skipped: List[str] = []  # guarded-by: _lock
+        self._last_shipped: Dict[str, Any] = {}  # guarded-by: _lock
         # Compile attribution for the CURRENT trial (merged by
         # note_compile; *_ms fields accumulate across e.g. the per-shape
         # AOT compiles of one trial) and the finished records awaiting
         # shipment (ship-once channel, requeued on a failed beat).
-        self._compile: Dict[str, Any] = {}
-        self._compile_final = False
-        self._ttfm_accounted: Optional[float] = None
-        self._compile_events: List[Dict[str, Any]] = []
+        self._compile: Dict[str, Any] = {}  # guarded-by: _lock
+        self._compile_final = False  # guarded-by: _lock
+        self._ttfm_accounted: Optional[float] = None  # guarded-by: _lock
+        self._compile_events: List[Dict[str, Any]] = []  # guarded-by: _lock
         # Cumulative warm-slot / compilation-cache counters for THIS
         # runner (train/warm.py routes them here through the trial scope).
-        self._counters: Dict[str, int] = {}
+        self._counters: Dict[str, int] = {}  # guarded-by: _lock
 
     # ----------------------------------------------------------- recording
 
@@ -168,6 +168,7 @@ class RunnerStats:
             self._trial_id = None
             self._trial_t0 = None
 
+    # locked-by: _lock
     def _finalize_compile_locked(self) -> None:
         if self._compile_final or not self._compile:
             return

@@ -23,6 +23,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from maggy_tpu.telemetry.vocab import SPAN_PHASES
+
 #: Trial phases in nominal order (a requeued trial may revisit phases; the
 #: journal records every occurrence, derivation picks the appropriate one).
 #: ``requeued`` marks a trial re-entering the schedule after runner loss /
@@ -45,10 +47,10 @@ from typing import Any, Dict, List, Optional
 #: breakdown (warm flag + init_ms/trace_ms/compile_ms/first_step_ms/
 #: ttfm_ms — see telemetry/runnerstats.py): warm trials reuse the runner's
 #: resident program (train/warm.py), cold trials paid the XLA compile.
-PHASES = ("suggested", "queued", "assigned", "running", "first_metric",
-          "stop_flagged", "stop_sent", "finalized", "lost", "requeued",
-          "profile_skipped", "prefetch_hit", "prefetch_miss",
-          "preempt_requested", "preempted", "resumed", "compiled")
+#: One home: telemetry/vocab.py — the shared emitter/consumer vocabulary
+#: the journalvocab checker (maggy_tpu.analysis) verifies both sides
+#: against. Re-exported here for compatibility.
+PHASES = SPAN_PHASES
 
 #: Gaps at or above this bound are scheduling (a runner idling on purpose at
 #: a rung barrier), not hand-off overhead — excluded from the gap stats.
@@ -79,7 +81,7 @@ class SpanTracker:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._spans: Dict[str, TrialSpan] = {}
+        self._spans: Dict[str, TrialSpan] = {}  # guarded-by: _lock
 
     def mint(self, trial_id: str) -> str:
         """Create (or return) the span for ``trial_id``."""

@@ -1,0 +1,99 @@
+"""The journal vocabulary: every string the telemetry journal speaks.
+
+One home for the stringly-typed contract between EMITTERS (``Telemetry.
+trial_event`` / ``Telemetry.event`` call sites across the package) and
+CONSUMERS (``spans.derive`` / ``replay_journal``, ``trace.py``,
+``monitor``, ``chaos/harness.py`` invariants, ``fleet.replay_fleet_
+journal``). An emitter typo used to vanish silently from replay,
+Perfetto, and invariant checking all at once; the ``journalvocab``
+checker (``python -m maggy_tpu.analysis``) now statically verifies
+
+- every literal phase/kind/reason EMITTED appears here,
+- every entry here is emitted somewhere (no orphan vocabulary), and
+- every literal a CONSUMER matches against appears here (a consumer typo
+  matches nothing — the worst kind of false green).
+
+Extend the vocabulary here FIRST, then emit/consume. Entries are plain
+frozensets so the checker (pure AST, no imports) can read them
+literally: keep every entry a literal string in this file.
+"""
+
+from __future__ import annotations
+
+#: Trial-span lifecycle + annotation phases (``ev: "trial"`` events).
+#: Nominal order; see telemetry/spans.py for the semantics of each.
+SPAN_PHASES = (
+    "suggested", "queued", "assigned", "running", "first_metric",
+    "stop_flagged", "stop_sent", "finalized", "lost", "requeued",
+    "profile_skipped", "prefetch_hit", "prefetch_miss",
+    "preempt_requested", "preempted", "resumed", "compiled",
+)
+
+#: Top-level journal event kinds (the ``ev`` field).
+EVENT_KINDS = frozenset({
+    "trial",                  # span phase occurrence (phase in SPAN_PHASES)
+    "suggest",                # controller suggest() latency sample
+    "runner_stats",           # heartbeat-piggybacked runner stats delta
+    "runner",                 # trial-runner lifecycle (phase: RUNNER_PHASES)
+    "worker",                 # dist-worker lifecycle (phase: WORKER_PHASES)
+    "experiment",             # experiment lifecycle (phase: EXPERIMENT_PHASES)
+    "prefetch_invalidated",   # schedule-stale prefetches dropped
+    "chaos",                  # one fault injection
+    "chaos_armed",            # chaos engine armed for the experiment
+    "chaos_summary",          # end-of-experiment injection tally
+    "health",                 # health engine finding / lifecycle
+    "fleet",                  # fleet lifecycle (phase: FLEET_PHASES)
+    "fleet_submit",           # experiment submitted to the fleet
+    "fleet_admit",            # experiment admitted past the queue
+    "fleet_experiment",       # per-experiment fleet lifecycle
+    "lease",                  # runner lease start/end (phase: LEASE_PHASES)
+    "preempt",                # fleet preemption decision
+})
+
+#: ``reason=`` on a trial ``requeued`` phase: why it re-entered the
+#: schedule.
+REQUEUE_REASONS = frozenset({
+    "blacklist",        # executor died and re-registered (BLACK path)
+    "heartbeat_loss",   # runner went silent holding the trial (LOST path)
+    "dead_partition",   # fresh suggestion rerouted off a dead runner
+    "preempted",        # graceful scheduler preemption (resume-capable)
+})
+
+#: ``phase=`` per non-trial event kind.
+EXPERIMENT_PHASES = frozenset({"start", "resumed", "finalized", "end"})
+RUNNER_PHASES = frozenset({"registered"})
+WORKER_PHASES = frozenset({"registered", "finalized"})
+FLEET_PHASES = frozenset({"start", "stop"})
+#: fleet_experiment mirrors the scheduler entry states.
+FLEET_EXPERIMENT_PHASES = frozenset({"start", "done", "failed"})
+LEASE_PHASES = frozenset({"start", "end"})
+#: ``reason=`` on a lease ``end``.
+LEASE_END_REASONS = frozenset({"released", "error"})
+
+#: Chaos fault kinds — the ``kind=`` field of ``ev: "chaos"`` injection
+#: records (mirrors chaos/plan.py KINDS; the chaos plan validates kinds
+#: at build time, this copy lets replay/trace/invariant consumers be
+#: checked without importing the chaos engine).
+CHAOS_KINDS = frozenset({
+    "kill_runner", "stall_runner", "fake_preemption", "preempt_trial",
+    "drop_msg", "delay_msg", "sever_conn", "env_write_fail",
+})
+
+#: Health-engine event fields (``ev: "health"``).
+HEALTH_STATUSES = frozenset({"raised", "cleared", "started", "error"})
+HEALTH_CHECKS = frozenset({"engine", "straggler", "hb_rtt", "hang"})
+
+#: Everything a consumer may match a ``phase`` field against — the union
+#: the journalvocab checker verifies consumer literals into.
+ALL_PHASES = (frozenset(SPAN_PHASES) | EXPERIMENT_PHASES | RUNNER_PHASES
+              | WORKER_PHASES | FLEET_PHASES | FLEET_EXPERIMENT_PHASES
+              | LEASE_PHASES)
+ALL_REASONS = REQUEUE_REASONS | LEASE_END_REASONS
+
+__all__ = [
+    "SPAN_PHASES", "EVENT_KINDS", "REQUEUE_REASONS",
+    "EXPERIMENT_PHASES", "RUNNER_PHASES", "WORKER_PHASES",
+    "FLEET_PHASES", "FLEET_EXPERIMENT_PHASES", "LEASE_PHASES",
+    "LEASE_END_REASONS", "CHAOS_KINDS", "HEALTH_STATUSES",
+    "HEALTH_CHECKS", "ALL_PHASES", "ALL_REASONS",
+]

@@ -307,7 +307,7 @@ class _InitEntry:
         self.opt_tx = None
         self.opt_family = None
         self.opt_reinit_jit = None
-        self.retired: Optional[tuple] = None
+        self.retired: Optional[tuple] = None  # guarded-by: lock
         self.lock = threading.Lock()
 
     def store_retired(self, variables, opt_state, family) -> None:
@@ -339,9 +339,9 @@ class WarmSlot:
     def __init__(self, key):
         self.key = key
         self.lock = threading.Lock()
-        self.step_jit = None
-        self.compiled: "OrderedDict[str, Any]" = OrderedDict()
-        self.inits: "OrderedDict[Any, _InitEntry]" = OrderedDict()
+        self.step_jit = None  # guarded-by: lock
+        self.compiled: "OrderedDict[str, Any]" = OrderedDict()  # guarded-by: lock
+        self.inits: "OrderedDict[Any, _InitEntry]" = OrderedDict()  # guarded-by: lock
         self.aot_ok = True
         # Serializes AOT lower+compile per slot: N thread-pooled runners
         # whose first trials race the same program must produce ONE
@@ -401,7 +401,7 @@ class WarmCache:
                                          DEFAULT_WARM_SLOTS))
         self.maxsize = max(1, maxsize)
         self._lock = threading.Lock()
-        self._slots: "OrderedDict[Any, WarmSlot]" = OrderedDict()
+        self._slots: "OrderedDict[Any, WarmSlot]" = OrderedDict()  # guarded-by: _lock
 
     def slot(self, key) -> Tuple[WarmSlot, bool]:
         """Get-or-create the slot for ``key``; returns (slot, existed)."""

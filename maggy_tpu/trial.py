@@ -48,18 +48,18 @@ class Trial:
         self.params = params
         self.trial_type = trial_type
         self.trial_id = Trial._compute_id(params, trial_type)
-        self.status = Trial.PENDING
-        self.early_stop = False
+        self.status = Trial.PENDING  # guarded-by: lock
+        self.early_stop = False  # guarded-by: lock
         # Scheduler preemption in flight: the early-stop flag carries the
         # STOP to the runner, this flag marks it as a preemption (the
         # runner acks with a preempted FINAL instead of finalizing).
-        self.preempt = False
-        self.final_metric: Optional[float] = None
-        self.metric_history: List[float] = []
-        self.step_history: List[int] = []
-        self.metric_dict: Dict[int, float] = {}
-        self.start: Optional[float] = None
-        self.duration: Optional[float] = None
+        self.preempt = False  # guarded-by: lock
+        self.final_metric: Optional[float] = None  # guarded-by: lock
+        self.metric_history: List[float] = []  # guarded-by: lock
+        self.step_history: List[int] = []  # guarded-by: lock
+        self.metric_dict: Dict[int, float] = {}  # guarded-by: lock
+        self.start: Optional[float] = None  # guarded-by: lock
+        self.duration: Optional[float] = None  # guarded-by: lock
         self.info_dict: Dict[str, Any] = info_dict or {}
         self.lock = threading.RLock()
 
@@ -184,5 +184,6 @@ class Trial:
 
     def __repr__(self):
         return "Trial(id={}, status={}, params={})".format(
+            # unguarded-ok: diagnostic repr — a lock here can deadlock crash logs
             self.trial_id, self.status, self.params
         )

@@ -48,6 +48,12 @@ def pytest_configure(config):
         "surfacing in bench.py. Select with -m perf.")
     config.addinivalue_line(
         "markers",
+        "analysis: static concurrency/protocol conformance analysis "
+        "(maggy_tpu.analysis) — the four checkers against firing/clean "
+        "fixtures, the runtime lock-order witness, and the tier-1 "
+        "package-must-analyze-clean gate. Select with -m analysis.")
+    config.addinivalue_line(
+        "markers",
         "fleet: shared-fleet scheduler tests (maggy_tpu.fleet) — "
         "multiplexing concurrent experiments over one runner fleet with "
         "fair share, priorities, and checkpoint-assisted preemption. "

@@ -312,3 +312,34 @@ class TestSchedulingTelemetryCompile:
         sched = bench.scheduling_telemetry(str(tmp_path), [])
         assert sched["source"] == "trial_json_fallback"
         assert sched["compile"] == {}
+
+
+class TestAnalysisDetail:
+    """detail.analysis carries the static posture (and, for soaks, the
+    witness edge count) so concurrency-discipline drift is visible in the
+    bench trajectory without re-running the analyzer."""
+
+    def test_posture_on_clean_repo(self):
+        d = bench.analysis_detail()
+        assert d["findings"] == 0
+        assert set(d["per_checker"]) == {"guards", "lockorder", "rpcconf",
+                                         "journalvocab"}
+        assert d["locks"] >= 30 and d["order_edges"] >= 20
+        assert "witness_edges" not in d  # no soak ran under the witness
+
+    def test_witness_block_merged(self):
+        d = bench.analysis_detail(
+            {"edge_count": 17, "violations": ["lock-order violation: x"]})
+        assert d["witness_edges"] == 17
+        assert d["witness_violations"] == 1
+
+    def test_analyzer_failure_is_best_effort(self, monkeypatch):
+        import maggy_tpu.analysis as _an
+
+        def boom(*a, **kw):
+            raise RuntimeError("parse exploded")
+
+        monkeypatch.setattr(_an, "run_analysis", boom)
+        d = bench.analysis_detail({"edge_count": 3, "violations": []})
+        assert "parse exploded" in d["error"]
+        assert d["witness_edges"] == 3

@@ -540,8 +540,15 @@ class TestDeterministicSmokeSoak:
 
     def test_soak_invariants_hold(self, tmp_path):
         report = run_soak(seed=7, num_trials=10, workers=3,
-                          base_dir=str(tmp_path / "soak"))
+                          base_dir=str(tmp_path / "soak"),
+                          lock_witness=True)
         assert report["ok"], report["violations"]
+        # The soak doubled as a dynamic race check (the lock-order
+        # witness, maggy_tpu.analysis.witness): real acquisition edges
+        # were recorded and none is forbidden by the static canonical
+        # order.
+        assert report["witness"]["violations"] == []
+        assert report["witness"]["edge_count"] > 0
         assert report["trials"]["queued"] == 10
         assert report["trials"]["finalized"] == 10
         # >= 3 fault kinds actually injected, including the mid-trial kill.
