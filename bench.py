@@ -800,6 +800,61 @@ def fleet_main():
     return 0 if report["ok"] else 1
 
 
+def pack_main():
+    """``bench.py --pack``: gang-scheduling pack soak (see
+    maggy_tpu/gang.py). Runs the mixed sweep — 1-chip ASHA rung-0 trials
+    + 4-chip fsdp gang promotions — on an 8-fake-device CPU proxy fleet
+    and prints one JSON line whose detail.pack block carries the
+    journal-replayed packing numbers (chip-seconds utilization,
+    fragmentation stalls, gang assembly latency p50/p95). Always a CPU
+    proxy (the fake-device count IS the topology under test), so runs
+    are comparable across hosts per the ROADMAP platform-gating note.
+    Exit 1 if the sweep deadlocks, utilization misses the 0.7 gate, or a
+    gang trial diverges from the single-process sharded reference."""
+    if "MAGGY_TPU_BASE_DIR" not in os.environ:
+        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
+    # Before any jax import: the pack soak's topology is 8 fake host
+    # devices, regardless of what accelerator the host has.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    for var in _ACCEL_BOOTSTRAP_VARS:
+        os.environ.pop(var, None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    from maggy_tpu.gang import run_pack_soak
+
+    seed = int(os.environ.get("BENCH_PACK_SEED", "7"))
+    t0 = time.time()
+    report = run_pack_soak(seed=seed)
+    pack = report["pack"]
+    print(json.dumps({
+        "metric": "gang pack soak (mixed 1-chip ASHA + 4-chip fsdp gangs "
+                  "on 8 fake devices, journal-replayed)",
+        "value": pack.get("chip_seconds_utilization") or 0.0,
+        "unit": "chip_seconds_utilization",
+        "detail": {
+            "seed": seed,
+            "wall_s": round(time.time() - t0, 1),
+            "violations": report["violations"],
+            "pack": pack,
+            # Gang-vs-reference parity (MULTICHIP dryrun parity): each
+            # gang trial's final loss against the single-process sharded
+            # reference for its declared shape.
+            "parity": report["parity"],
+            "platform": "cpu proxy (8 fake devices via "
+                        "--xla_force_host_platform_device_count)",
+            "journal": report["journal"],
+            "result": report["result"],
+            # Gang assembly as grouped lanes + pack instants: validated
+            # perfetto-loadable or None.
+            "trace": _export_trace_artifact(
+                os.path.dirname(report["journal"])),
+        },
+    }), flush=True)
+    return 0 if report["ok"] else 1
+
+
 def extra_main(name):
     """Child process: run ONE extra bench and print its JSON on stdout."""
     if name == "hang":  # test hook: simulates a compile stall / wedged op
@@ -1240,4 +1295,6 @@ if __name__ == "__main__":
         sys.exit(chaos_main())
     if "--fleet" in sys.argv:
         sys.exit(fleet_main())
+    if "--pack" in sys.argv:
+        sys.exit(pack_main())
     sys.exit(main())

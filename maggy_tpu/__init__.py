@@ -19,6 +19,7 @@ from maggy_tpu.config import (
     DistributedConfig,
 )
 from maggy_tpu.core.executors.context import TrialContext
+from maggy_tpu.gang import GangSpec
 
 __all__ = [
     "Searchspace",
@@ -28,4 +29,5 @@ __all__ = [
     "AblationConfig",
     "DistributedConfig",
     "TrialContext",
+    "GangSpec",
 ]

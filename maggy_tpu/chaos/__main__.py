@@ -83,6 +83,15 @@ def main(argv=None) -> int:
                          "scheduler's mechanism) — exactly one FINAL, and "
                          "the trial resumes from its checkpoint step, not "
                          "step 0 (invariant 7)")
+    ap.add_argument("--gang", action="store_true",
+                    help="run the gang-revocation soak: a mixed 1-chip + "
+                         "4-chip-fsdp ASHA sweep with one member of the "
+                         "first assembled gang killed mid-trial — the "
+                         "whole gang lease must be revoked and the trial "
+                         "requeued exactly once (invariant 8); run under "
+                         "JAX_PLATFORMS=cpu with "
+                         "XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count=8")
     ap.add_argument("--show-schedule", action="store_true",
                     help="print the plan's deterministic decision "
                          "expansion and exit (no experiment)")
@@ -97,12 +106,12 @@ def main(argv=None) -> int:
     from maggy_tpu.chaos import harness
     from maggy_tpu.chaos.plan import FaultPlan
 
-    modes = [m for m in ("stall", "piggyback", "preempt")
+    modes = [m for m in ("stall", "piggyback", "preempt", "gang")
              if getattr(args, m)]
     if args.plan and modes:
         ap.error("--{} uses a built-in plan; drop --plan".format(modes[0]))
     if len(modes) > 1:
-        ap.error("pick one of --stall / --piggyback / --preempt")
+        ap.error("pick one of --stall / --piggyback / --preempt / --gang")
     if args.plan:
         plan = FaultPlan.load(args.plan)
         # A reproduction run must honor the plan file's embedded seed;
@@ -118,6 +127,9 @@ def main(argv=None) -> int:
     elif args.preempt:
         plan = harness.preempt_plan(seed=7 if args.seed is None
                                     else args.seed)
+    elif args.gang:
+        plan = harness.gang_plan(seed=7 if args.seed is None
+                                 else args.seed)
     else:
         plan = harness.default_plan(seed=7 if args.seed is None
                                     else args.seed)
@@ -127,6 +139,14 @@ def main(argv=None) -> int:
                           "schedule": plan.fingerprint()}, indent=2))
         return 0
 
+    if args.gang:
+        # The gang soak owns its whole config (mixed ASHA sweep over an
+        # 8-runner fleet with GangSpec budgets) — delegate wholesale.
+        report = harness.run_gang_soak(
+            seed=plan.seed, num_trials=args.trials,
+            lock_witness=not args.no_witness)
+        print(json.dumps(report, indent=2, default=str))
+        return 0 if report["ok"] else 1
     if args.preempt:
         # The preempt soak needs a checkpointing, ctx-aware trial so the
         # resume provably restarts from the checkpoint step.

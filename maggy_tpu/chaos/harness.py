@@ -48,6 +48,17 @@ checkable against any soak artifact after the fact):
     ``maggy_tpu.fleet.soak.run_fleet_soak`` (queue-wait bound over
     ``replay_fleet_journal``). ``preempt_plan``, ``python -m
     maggy_tpu.chaos --preempt``.
+8.  **Gang revocation is whole and exactly-once** — every injected
+    ``kill_gang_member`` fault (one non-leader member of an assembled
+    gang killed mid-trial) whose detection won the race against the
+    trial's FINAL is followed by the WHOLE gang's release
+    (``gang_released``), the trial's requeue with reason
+    ``gang_member_lost`` exactly once, and a later re-assembly
+    (``gang_assembled``) on a fresh gang — and invariants 1/2 still
+    hold: the revoked leader's in-flight FINAL must be dropped, never
+    double-finalized. A trial that outran detection is the benign
+    completed_before_detection outcome. ``gang_plan``, ``python -m
+    maggy_tpu.chaos --gang``.
 """
 
 from __future__ import annotations
@@ -62,7 +73,8 @@ from maggy_tpu.chaos.plan import FaultPlan, FaultSpec
 #: preempt_trial requeues through the preempted-FINAL ack (reason
 #: "preempted") — unless the trial outran the STOP and finalized first,
 #: the benign completed_before_detection outcome.
-_REQUEUE_KINDS = ("kill_runner", "fake_preemption", "preempt_trial")
+_REQUEUE_KINDS = ("kill_runner", "fake_preemption", "preempt_trial",
+                  "kill_gang_member")
 
 
 def default_plan(seed: int = 7) -> FaultPlan:
@@ -126,6 +138,102 @@ def preempt_plan(seed: int = 7, nth: int = 2) -> FaultPlan:
         FaultSpec("preempt_trial", trigger={"on_phase": "first_metric",
                                             "nth": nth}),
     ], seed=seed)
+
+
+def gang_plan(seed: int = 7, nth: int = 1) -> FaultPlan:
+    """One non-leader member of the Nth assembled gang killed right
+    after assembly (invariant 8): the member's heartbeats go silent
+    mid-trial, the driver must revoke the WHOLE gang lease — healthy
+    members (and the still-computing leader, via a reservation-level
+    preempt STOP) return to the pool — and the trial requeues with
+    reason ``gang_member_lost`` exactly once, then reassembles a fresh
+    gang around the dead chip."""
+    return FaultPlan([
+        FaultSpec("kill_gang_member",
+                  trigger={"on_phase": "gang_assembled", "nth": nth}),
+    ], seed=seed)
+
+
+def gang_soak_train_fn(lr, budget=1, gang=None, reporter=None, ctx=None):
+    """Gang soak trial: the pack soak's sharded MLP, slowed to
+    heartbeating paced steps — ~1.6 s busy for 1-chip trials, ~4 s for
+    gang trials — so member-loss detection (hb_loss_timeout, 1 s in the
+    soak) lands mid-gang-trial with margin instead of racing the FINAL."""
+    from maggy_tpu.gang import reference_gang_loss
+
+    del budget, gang
+    g = ctx.gang.to_dict() if ctx is not None and ctx.gang is not None \
+        else None
+    chips = len(g["chips"]) if g and isinstance(g.get("chips"), list) else 1
+    return {"metric": reference_gang_loss(lr, g, reporter=reporter,
+                                          steps=100 if chips > 1 else 40)}
+
+
+def run_gang_soak(seed: int = 7, num_trials: int = 10, workers: int = 8,
+                  gang_chips: int = 4,
+                  base_dir: Optional[str] = None,
+                  lock_witness: Optional[bool] = None) -> Dict[str, Any]:
+    """The gang chaos soak: the pack soak's mixed ASHA sweep (1-chip
+    rung-0 trials + ``gang_chips``-chip fsdp promotions on a
+    ``workers``-runner thread fleet) under ``gang_plan`` — one member of
+    the first assembled gang killed mid-trial. Asserts invariant 8 on
+    top of the standard suite, and fails loudly if the fault never
+    produced a revocation (a soak that raced every FINAL verified
+    nothing)."""
+    from maggy_tpu import Searchspace
+    from maggy_tpu.gang import GangSpec
+    from maggy_tpu.optimizers import Asha
+
+    # The soak's topology IS the fixture: ``workers`` runners ≈ chips by
+    # index, so the process needs >= gang_chips jax devices. Force the
+    # 8-fake-device CPU proxy (same as bench --pack / tests/conftest)
+    # while the backend is still uninitialized — without it a bare CPU
+    # host has ONE device, every gang trial dies instantly on a missing
+    # chip, and the kill always "loses the race": the soak verifies
+    # nothing.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count={}".format(
+                workers)).strip()
+    import jax
+
+    if jax.device_count() < workers:
+        raise RuntimeError(
+            "gang soak needs >= {} jax devices (the placer spans every "
+            "runner's chip) but the backend has {}; set XLA_FLAGS="
+            "--xla_force_host_platform_device_count={} before jax "
+            "initializes".format(workers, jax.device_count(), workers))
+
+    plan = gang_plan(seed)
+    # hb_loss_timeout rides ABOVE the jit-compile stalls 8 concurrently
+    # tracing runner threads inflict on each other's heartbeat cadence
+    # (0.3 s thrashes every partition with false losses) while staying
+    # well under the ~4 s gang trial so the member kill is detected
+    # mid-trial.
+    report = run_soak(
+        plan=plan, seed=seed, train_fn=gang_soak_train_fn,
+        num_trials=num_trials, workers=workers, pool="thread",
+        hb_interval=0.05, hb_loss_timeout=1.0, base_dir=base_dir,
+        lock_witness=lock_witness,
+        config_overrides=dict(
+            optimizer=Asha(reduction_factor=gang_chips, resource_min=1,
+                           resource_max=gang_chips, seed=seed),
+            searchspace=Searchspace(lr=("DOUBLE", [0.05, 0.2])),
+            chips_per_budget={1: GangSpec(1),
+                              gang_chips: GangSpec(gang_chips,
+                                                   strategy="fsdp")},
+        ))
+    revoked = [r for r in report.get("gang_revocations", [])
+               if r.get("outcome") == "revoked"]
+    if not revoked:
+        report["violations"].append(
+            "gang fault never produced a revocation: every "
+            "kill_gang_member injection lost the race to the trial's "
+            "FINAL — the soak exercised nothing (raise the trial length "
+            "or lower hb_loss_timeout)")
+        report["ok"] = False
+    return report
 
 
 def ckpt_train_fn(lr, units, reporter=None, ctx=None):
@@ -324,8 +432,11 @@ def check_invariants(events: List[Dict[str, Any]],
     queued: Dict[str, float] = {}
     finalized: Dict[str, List[float]] = {}
     requeued: Dict[str, List[float]] = {}
+    requeued_evs: Dict[str, List[Dict[str, Any]]] = {}
     preempted_evs: Dict[str, List[Dict[str, Any]]] = {}
     resumed_evs: Dict[str, List[Dict[str, Any]]] = {}
+    gang_assembled: Dict[str, List[Dict[str, Any]]] = {}
+    gang_released: Dict[str, List[Dict[str, Any]]] = {}
     chaos_events: List[Dict[str, Any]] = []
     health_raised: List[Dict[str, Any]] = []
     health_by_check: Dict[str, int] = {}
@@ -358,6 +469,11 @@ def check_invariants(events: List[Dict[str, Any]],
             queued.setdefault(trial, t)
         elif phase == "requeued":
             requeued.setdefault(trial, []).append(t)
+            requeued_evs.setdefault(trial, []).append(dict(ev))
+        elif phase == "gang_assembled":
+            gang_assembled.setdefault(trial, []).append(dict(ev))
+        elif phase == "gang_released":
+            gang_released.setdefault(trial, []).append(dict(ev))
         elif phase == "preempted":
             preempted_evs.setdefault(trial, []).append(dict(ev))
         elif phase == "resumed":
@@ -491,6 +607,60 @@ def check_invariants(events: List[Dict[str, Any]],
                             trial, from_step))
         preempt_recs.append(rec)
 
+    # Invariant 8: gang revocation is whole and exactly-once. A
+    # kill_gang_member fault whose member-loss detection won the race
+    # against the trial's FINAL must be followed by the WHOLE gang's
+    # release, the trial's requeue with reason gang_member_lost exactly
+    # once, and a later re-assembly (the trial can only ever run through
+    # a gang, and invariant 1 demands it finalizes).
+    gang_recs: List[Dict[str, Any]] = []
+    for ce in chaos_events:
+        if ce.get("kind") != "kill_gang_member":
+            continue
+        trial, t0 = ce.get("trial"), ce.get("t")
+        if trial is None or t0 is None:
+            continue
+        rec: Dict[str, Any] = {"trial": trial,
+                               "victim": ce.get("partition"),
+                               "leader": ce.get("leader")}
+        gml = [e for e in requeued_evs.get(trial, [])
+               if e.get("t") is not None and e["t"] >= t0
+               and e.get("reason") == "gang_member_lost"]
+        if not gml:
+            if [t for t in finalized.get(trial, []) if t >= t0]:
+                rec["outcome"] = "completed_before_detection"
+            else:
+                rec["outcome"] = "unrevoked"
+                violations.append(
+                    "unrevoked gang: kill_gang_member fault on trial {} "
+                    "(victim runner {}) produced neither a "
+                    "gang_member_lost requeue nor a FINAL".format(
+                        trial, ce.get("partition")))
+            gang_recs.append(rec)
+            continue
+        rec["outcome"] = "revoked"
+        rec["requeues"] = len(gml)
+        rec["revoke_latency_s"] = round(min(e["t"] for e in gml) - t0, 3)
+        if len(gml) > 1:
+            violations.append(
+                "gang over-requeue: trial {} carries {} gang_member_lost "
+                "requeues for one kill_gang_member fault".format(
+                    trial, len(gml)))
+        if not [e for e in gang_released.get(trial, [])
+                if e.get("t") is not None and e["t"] >= t0]:
+            violations.append(
+                "gang lease not released: trial {} was revoked but the "
+                "journal carries no gang_released edge after the "
+                "fault".format(trial))
+        t_req = min(e["t"] for e in gml)
+        if not [e for e in gang_assembled.get(trial, [])
+                if e.get("t") is not None and e["t"] >= t_req]:
+            violations.append(
+                "gang never reassembled: trial {} was requeued for "
+                "gang_member_lost but no later gang_assembled edge "
+                "exists".format(trial))
+        gang_recs.append(rec)
+
     # Invariant 5: stall -> health flag. A frozen runner shorter than the
     # loss bound is invisible to the heartbeat-loss scan; the health
     # engine's hang watchdog (or straggler scoring) must still see it,
@@ -534,6 +704,7 @@ def check_invariants(events: List[Dict[str, Any]],
         "faults": {"injected": len(chaos_events), "by_kind": by_kind},
         "recoveries": recoveries,
         "preemptions": preempt_recs,
+        "gang_revocations": gang_recs,
         "health": {"engine_ran": health_engine_ran,
                    "raised": len(health_raised),
                    "by_check": health_by_check,

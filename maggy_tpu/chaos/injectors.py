@@ -343,6 +343,32 @@ class ChaosEngine:
                 trial = preempted
             detail["mechanism"] = "graceful" if preempted is not None \
                 else "noop"
+        elif spec.kind == "kill_gang_member":
+            # Kill one NON-leader member of the trial's assembled gang
+            # (the on_phase=gang_assembled event's partition IS the
+            # leader; killing the leader is the ordinary LOST path the
+            # kill_runner fault already covers). Victim choice is
+            # deterministic: the lowest member id that isn't the
+            # triggering partition. Falls back to the triggering
+            # partition when the gang table is gone (released in the
+            # window between trigger and firing) so the injection is
+            # journaled either way.
+            members: List[int] = []
+            if self.driver is not None and trial is not None:
+                try:
+                    members = [int(m)
+                               for m in self.driver.gang_members(trial)
+                               if int(m) != pid]
+                except Exception:  # noqa: BLE001 - injection must never crash the hook
+                    members = []
+            victim = min(members) if members else pid
+            with self._lock:
+                self._condemned.add(victim)
+            killed = bool(self.pool is not None
+                          and self.pool.kill_worker(victim))
+            detail["mechanism"] = "sigkill" if killed else "cooperative"
+            detail["leader"] = pid
+            pid = victim
         elif spec.kind == "fake_preemption":
             # The runner stays alive; only the driver's view of its
             # heartbeats is aged — the falsely-declared-lost race. The

@@ -25,6 +25,11 @@ kinds
                          declares it lost while it is actually alive —
                          the falsely-declared-lost race (duplicate-FINAL
                          path).
+    ``kill_gang_member`` kill one non-leader member of an assembled
+                         gang (fire it ``on_phase: gang_assembled``) —
+                         the whole gang lease must be revoked, the
+                         members returned to the pool, and the trial
+                         requeued exactly once.
     ``drop_msg``         the server discards a matching request unseen
                          and resets the connection (message lost; the
                          client's retry path re-delivers).
@@ -71,6 +76,7 @@ KINDS = (
     "stall_runner",
     "fake_preemption",
     "preempt_trial",
+    "kill_gang_member",
     "drop_msg",
     "delay_msg",
     "sever_conn",
@@ -83,8 +89,13 @@ KINDS = (
 #: preemption path (the fleet scheduler's mechanism): the driver flags
 #: the partition's trial, the runner acks with its checkpoint step, and
 #: the trial must resume from that step — invariant 7.
+#: ``kill_gang_member`` kills one NON-LEADER member of an assembled
+#: gang (trigger it ``on_phase: gang_assembled`` so the event names the
+#: gang trial; the engine resolves the victim through the driver's gang
+#: table) — the whole gang's lease must be revoked and the trial
+#: requeued exactly once (invariant 8).
 RUNNER_KINDS = ("kill_runner", "stall_runner", "fake_preemption",
-                "preempt_trial")
+                "preempt_trial", "kill_gang_member")
 
 _TRIGGER_KEYS = ("after_s", "nth", "every_nth", "probability", "on_phase")
 

@@ -115,13 +115,24 @@ class OptimizationConfig(LagomConfig):
     # Per-trial device assignment: how many TPU chips each trial gets
     # (used by pool="tpu").
     chips_per_trial: int = 1
-    # Elastic sub-slice sizing (pool="elastic"): budget -> chips. A
-    # promoted ASHA/Hyperband trial at a larger budget gets a larger chip
-    # sub-slice; runners exit and respawn re-pinned when their capacity
-    # doesn't match the next trial's requirement (SURVEY §7.3's
-    # slice-repartitioning problem). Budgets missing from the map use
-    # chips_per_trial.
-    chips_per_budget: Optional[Dict[Any, int]] = None
+    # Multi-chip trial sizing: budget -> chip need. Two mechanisms share
+    # the declaration, selected by the pool:
+    # - pool="elastic" (int values): budget-sized chip sub-slices —
+    #   runners exit and respawn re-pinned when their capacity doesn't
+    #   match the next trial's requirement (SURVEY §7.3's
+    #   slice-repartitioning problem). Budgets missing from the map use
+    #   chips_per_trial.
+    # - pool="thread" / fleet mode (int or maggy_tpu.gang.GangSpec
+    #   values): GANG SCHEDULING — the driver assembles N fleet runners
+    #   (runner ≈ chip) into one contiguous mesh slice, dispatches the
+    #   trial to a designated leader (ctx.gang carries the mesh axes +
+    #   strategy), and holds the members until the trial releases. A
+    #   bare int N is shorthand for GangSpec(N) (dp mesh). Packing is
+    #   topology-aware (best-fit aligned contiguous blocks, journaled
+    #   pack events — see docs/user.md "Multi-chip sweeps").
+    # A Searchspace GANG entry declares the same thing per trial instead
+    # of per budget (and lets the sweep SEARCH over sharding shapes).
+    chips_per_budget: Optional[Dict[Any, Any]] = None
     # Total chips the elastic pool may lease (None -> probe the host).
     total_chips: Optional[int] = None
     # Pipelined trial hand-off: the driver pre-materializes controller
@@ -171,10 +182,35 @@ class OptimizationConfig(LagomConfig):
             raise ValueError(
                 "pool must be 'thread', 'process', 'tpu', 'elastic', or "
                 "'remote'")
-        if self.chips_per_budget is not None and self.pool != "elastic":
+        if self.chips_per_budget is not None and \
+                self.pool not in ("elastic", "thread"):
             raise ValueError(
-                "chips_per_budget needs pool='elastic' (budget-sized chip "
-                "sub-slices require respawnable pinned workers)")
+                "chips_per_budget needs pool='elastic' (budget-sized "
+                "respawnable pinned workers) or pool='thread' "
+                "(gang-scheduled runner groups); got pool={!r}".format(
+                    self.pool))
+        if self.chips_per_budget is not None and self.pool == "elastic":
+            from maggy_tpu.gang import GangSpec
+
+            if any(isinstance(v, (GangSpec, dict))
+                   for v in self.chips_per_budget.values()):
+                raise ValueError(
+                    "GangSpec chips_per_budget values gang-schedule fleet "
+                    "runners and need pool='thread' (or fleet mode); the "
+                    "elastic pool respawns single pinned runners from int "
+                    "chip counts")
+        if self.searchspace is not None:
+            gang_names = [n for n in self.searchspace.names()
+                          if self.searchspace.get_type(n) == "GANG"]
+            if gang_names and self.pool not in ("thread",):
+                raise ValueError(
+                    "a Searchspace GANG entry gang-schedules fleet runners "
+                    "and needs pool='thread' (or fleet mode); got "
+                    "pool={!r}".format(self.pool))
+            if len(gang_names) > 1:
+                raise ValueError(
+                    "at most one Searchspace GANG entry per sweep (a trial "
+                    "runs on one gang); got {}".format(gang_names))
         if isinstance(self.num_workers, str) and self.num_workers != "auto":
             raise ValueError(
                 "num_workers must be an int or 'auto', got {!r}".format(

@@ -45,7 +45,7 @@ class Driver(ABC):
         self._message_q: "queue.Queue[Dict[str, Any]]" = queue.Queue()
         self.message_callbacks: Dict[str, Callable[[Dict[str, Any]], None]] = {}
         self.worker_done = False
-        self.experiment_done = False
+        self.experiment_done = False  # unguarded-ok: monotonic completion latch, polled lock-free by design
         self._worker_thread: Optional[threading.Thread] = None
         self.executor_logs: list = []  # guarded-by: _log_lock
         self._log_lock = threading.Lock()
@@ -248,15 +248,20 @@ class Driver(ABC):
                 try:
                     callback(msg)
                 except Exception as exc:  # noqa: BLE001 - keep worker alive, surface later
+                    # Flags before the slow traceback log (see
+                    # _suggester_loop: an exception observer must already
+                    # see the experiment done).
                     self.exception = exc
-                    self._log("worker callback error: {}".format(traceback.format_exc()))
                     self.experiment_done = True
+                    self._log("worker callback error: {}".format(traceback.format_exc()))
 
         self._worker_thread = threading.Thread(target=worker, daemon=True, name="driver-worker")
         self._worker_thread.start()
 
     def stop(self) -> None:
         self.worker_done = True
+        # unguarded-ok: cross-thread completion latch — monotonic bool,
+        # readers poll it lock-free by design
         self.experiment_done = True
         if self._worker_thread is not None:
             self._worker_thread.join(timeout=5)

@@ -17,6 +17,7 @@ import traceback
 from typing import Any, Dict, List, Optional
 
 from maggy_tpu import constants, util
+from maggy_tpu import gang as gang_mod
 from maggy_tpu.config import OptimizationConfig
 from maggy_tpu.core.driver.driver import Driver
 from maggy_tpu.core.executors.trial_executor import trial_executor_fn
@@ -76,7 +77,19 @@ class OptimizationDriver(Driver):
         max_conc = getattr(self.controller, "max_concurrency", None)
         ceiling = min(self.num_trials,
                       max_conc() if max_conc is not None else self.num_trials)
+        # Gang-scheduled trials need N runners for ONE trial, so the
+        # trial-count clamp must not shrink the pool below the largest
+        # declared gang.
+        max_gang = gang_mod.config_max_gang_chips(config)
+        if max_gang > 1 and getattr(config, "pool", "thread") != "elastic":
+            ceiling = max(ceiling, max_gang)
         self.num_executors = min(resolve_num_workers(config), ceiling)
+        if max_gang > 1 and getattr(config, "pool", "thread") != "elastic" \
+                and self.num_executors < max_gang:
+            raise ValueError(
+                "a declared gang needs {} chips but only {} runner(s) are "
+                "configured (num_workers); a gang can never "
+                "assemble".format(max_gang, self.num_executors))
         super().__init__(config, app_id, run_id)
 
         # Trial bookkeeping shared with the server thread.
@@ -93,7 +106,59 @@ class OptimizationDriver(Driver):
         # pools): the schedule already committed to them, but the runner
         # that triggered the suggestion is pinned to a different size.
         self._parked: List[str] = []  # guarded-by: _store_lock
-        self._chips_map = getattr(config, "chips_per_budget", None)
+        # Elastic respawn sizing reads chips_per_budget ONLY on the
+        # elastic pool; on thread/fleet pools the same declaration means
+        # gang scheduling (see below) and the elastic machinery stays off.
+        pool_kind = getattr(config, "pool", "thread")
+        self._chips_map = getattr(config, "chips_per_budget", None) \
+            if pool_kind == "elastic" else None
+
+        # ---- gang scheduling (multi-chip trials; maggy_tpu.gang) ----
+        # A trial declaring N>1 chips (GangSpec per budget, or a
+        # Searchspace GANG entry) is not assigned to one runner: the
+        # driver reserves a contiguous chip block through the placer,
+        # conscripts runners whose chips fall inside it as they free up
+        # (gang holds in the reservation table), and dispatches the
+        # trial to the lowest-chip member as LEADER once the block is
+        # fully held. The members keep heartbeating/idle-polling —
+        # their chips belong to the leader's mesh until the gang
+        # releases (FINAL/error/preemption/member loss).
+        self._gang_map = getattr(config, "chips_per_budget", None) \
+            if pool_kind != "elastic" else None
+        self._gang_mode = gang_mod.config_declares_gangs(config) \
+            and pool_kind != "elastic"
+        # The GANG-typed searchspace entry, found by TYPE — a user may
+        # name it anything ("topology", "sharding", ...); a by-name
+        # lookup would silently run every trial unsharded on one chip.
+        sp = getattr(config, "searchspace", None)
+        self._gang_param = next(
+            (n for n in sp.names() if sp.get_type(n) == "GANG"),
+            None) if sp is not None else None
+        binding = getattr(config, "fleet", None)
+        placer_chips = binding.fleet.num_runners if binding is not None \
+            else self.num_executors
+        if self._gang_mode and max_gang > placer_chips:
+            # The num_executors guard above covers thread pools; in
+            # fleet mode the placer spans the FLEET's runners — an
+            # oversized gang would wait in _gang_demand forever.
+            raise ValueError(
+                "a declared gang needs {} chips but the {} spans only "
+                "{} runner(s); the gang can never assemble".format(
+                    max_gang,
+                    "fleet" if binding is not None else "runner pool",
+                    placer_chips))
+        self._placer = gang_mod.GangPlacer(
+            placer_chips, telemetry=self.telemetry) \
+            if self._gang_mode else None
+        # Trials waiting for a gang (FIFO; requeued gang trials wait in
+        # _requeue instead and take priority).
+        self._gang_wait: List[str] = []  # guarded-by: _store_lock
+        # Assembled gangs: trial_id -> {chips, members, leader, mesh,
+        # strategy, revoking}.
+        self._gangs: Dict[str, Dict[str, Any]] = {}  # guarded-by: _store_lock
+        # Fleet-level contiguous-block reservation held while gangs are
+        # waiting or running (see FleetScheduler.request_gang).
+        self._fleet_gang_active = False  # guarded-by: _store_lock
         # Outstanding resize requests by target size: bounds the idle-runner
         # migration so a herd of idle runners doesn't all chase one parked
         # trial's size (decremented when a runner REGisters at that size).
@@ -343,6 +408,7 @@ class OptimizationDriver(Driver):
             IDLE=self._idle_msg_callback,
             REG=self._register_msg_callback,
             LOST=self._lost_msg_callback,
+            GANG_LOST=self._gang_lost_msg_callback,
         )
 
     def get_trial(self, trial_id):
@@ -392,6 +458,20 @@ class OptimizationDriver(Driver):
         """Executor died and re-registered: requeue its trial (reference
         :363-367 + `rpc.py:308-326`)."""
         trial = self.get_trial(msg["trial_id"])
+        if trial is not None and self.gang_members(trial.trial_id):
+            # A re-registered gang leader cannot simply take its trial
+            # back — its mesh slice is gone. Revoke the gang and let the
+            # backlog reassemble one.
+            self._release_gang(trial.trial_id, why="leader_blacklisted",
+                               partition=msg.get("partition_id"))
+            trial.reset_run_state()
+            with self._store_lock:
+                if trial.trial_id not in self._requeue:
+                    self._requeue.append(trial.trial_id)
+            self.telemetry.trial_event(trial.trial_id, "requeued",
+                                       partition=msg["partition_id"],
+                                       reason="blacklist")
+            return
         if trial is not None:
             trial.reset_run_state()
             # Explicit requeue edge BEFORE the reassignment: recovery
@@ -419,6 +499,11 @@ class OptimizationDriver(Driver):
         with self._store_lock:
             if trial.trial_id not in self._requeue:
                 self._requeue.append(trial.trial_id)
+        # A lost gang LEADER takes its whole gang down: the members'
+        # chips go back to the pool and the requeued trial re-assembles
+        # a fresh gang (the placer avoids the dead chip).
+        self._release_gang(trial.trial_id, why="leader_lost",
+                           partition=msg.get("partition_id"))
         self.telemetry.trial_event(trial.trial_id, "lost",
                                    partition=msg.get("partition_id"))
         # The explicit re-queue edge: without it the journal only shows a
@@ -580,6 +665,7 @@ class OptimizationDriver(Driver):
                 pid, size, why))
             if pool is not None:
                 pool.kill_worker(pid)
+        self._check_gang_members()
 
     def _pop_parked(self, capacity: Optional[int]) -> Optional[Trial]:
         """First parked trial this runner's capacity can serve (None
@@ -598,18 +684,281 @@ class OptimizationDriver(Driver):
     def _pop_requeue(self, capacity: Optional[int] = None) -> Optional[Trial]:
         """Next orphaned trial this runner can serve. Elastic pools match
         chip requirements here too — a budget-9 trial orphaned by a dead
-        2-chip runner must NOT land on a 1-chip runner."""
+        2-chip runner must NOT land on a 1-chip runner. Gang trials
+        (N>1 chips) are skipped-but-RETAINED: a single undersized runner
+        must never be served a trial whose mesh needs N chips — the
+        backlog entry waits for gang assembly (_service_gangs) and is
+        served intact to the whole gang, never split."""
         with self._store_lock:
             for i, tid in enumerate(list(self._requeue)):
                 trial = self._trial_store.get(tid)
                 if trial is None:
                     self._requeue.remove(tid)
                     continue
+                spec = self._gang_spec_for(trial)
+                if spec is not None and spec.chips > 1:
+                    continue
                 need = self._chips_for(trial)
                 if capacity is None or need is None or need == capacity:
                     self._requeue.remove(tid)
                     return trial
         return None
+
+    # ------------------------------------------------- gang scheduling
+
+    def _gang_spec_for(self, trial: Trial) -> Optional["gang_mod.GangSpec"]:
+        """The trial's declared gang shape (None = plain 1-runner
+        trial): a sampled Searchspace GANG param wins, else the
+        chips_per_budget entry for its budget."""
+        if not self._gang_mode:
+            return None
+        g = trial.params.get(self._gang_param) \
+            if self._gang_param is not None else None
+        if g:
+            return gang_mod.GangSpec.from_value(g)
+        if self._gang_map:
+            budget = trial.params.get("budget",
+                                      trial.info_dict.get("budget"))
+            v = self._gang_map.get(budget)
+            if v is not None:
+                return gang_mod.GangSpec.from_value(v)
+        return None
+
+    def _chip_of(self, partition_id: int) -> int:
+        """The runner's chip/topology index. Thread pools: runner ≈
+        chip, identity. Fleet mode: the fleet runner index this
+        partition is currently leased to (FleetLeasedPool.chip_of), so
+        contiguity means contiguous FLEET runners."""
+        pool = getattr(self, "_active_pool", None)
+        chip_of = getattr(pool, "chip_of", None)
+        if chip_of is not None:
+            chip = chip_of(partition_id)
+            if chip is not None:
+                return int(chip)
+        return int(partition_id)
+
+    # locked-by: _store_lock
+    def _gang_demand_locked(self) -> List[str]:
+        """Gang trials awaiting assembly, requeued (revoked/lost) ones
+        first — store lock held."""
+        demand = []
+        for tid in self._requeue + self._gang_wait:
+            if tid in demand or tid not in self._trial_store:
+                continue
+            trial = self._trial_store[tid]
+            spec = self._gang_spec_for(trial)
+            if spec is not None and spec.chips > 1 \
+                    and tid not in self._gangs:
+                demand.append(tid)
+        return demand
+
+    def _service_gangs_locked(self, partition_id: int) -> bool:
+        """Reserve blocks for waiting gang trials, conscript this (and
+        every other currently-free) runner whose chip falls inside one,
+        and assemble any gang whose block became fully held. Returns
+        True when the asking runner was conscripted — the caller must
+        hand it no other work. Sched lock held."""
+        if not self._gang_mode:
+            return False
+        res = self.server.reservations
+        with self._store_lock:
+            demand = self._gang_demand_locked()
+            running = bool(self._gangs)
+        self._sync_fleet_gang(bool(demand) or running)
+        if not demand:
+            return False
+        bound = self.server.hb_loss_timeout
+        free = [p for p in res.free_pids()
+                if bound is None or not res.is_silent(p, bound)]
+        chip_by_pid = {p: self._chip_of(p) for p in free}
+        free_chips = set(chip_by_pid.values())
+        # Chips whose runners can never come back (silent past the loss
+        # bound, or released): a reserved block containing one would
+        # park its gang forever.
+        dead_chips = set()
+        for pid, rec in res.all().items():
+            if rec.get("released") or (
+                    bound is not None and res.is_silent(pid, bound)):
+                dead_chips.add(self._chip_of(pid))
+        conscripted = False
+        for tid in demand:
+            trial = self.get_trial(tid)
+            if trial is None:
+                continue
+            spec = self._gang_spec_for(trial)
+            # Sticky reservations must not outlive their own viability: a
+            # block containing a chip that DIED while busy (so it was
+            # never gang-held and _check_gang_members never saw it) can
+            # never fully free — release and re-plan around the dead
+            # chip, or the gang parks forever.
+            existing = self._placer.block_of(tid)
+            if existing is not None and dead_chips & set(existing):
+                self._release_gang(tid, why="block_chip_dead")
+            block = self._placer.reserve(tid, spec.chips, free_chips,
+                                         avoid=dead_chips - free_chips)
+            if block is None:
+                continue
+            for p, c in list(chip_by_pid.items()):
+                if c in block:
+                    res.hold_for_gang(p, tid)
+                    if p == partition_id:
+                        conscripted = True
+                    del chip_by_pid[p]
+                    free_chips.discard(c)
+            members = res.gang_members(tid)
+            if len(members) >= spec.chips:
+                self._assemble_gang_locked(tid, trial, spec, block,
+                                           members)
+        return conscripted
+
+    def _assemble_gang_locked(self, tid: str, trial: Trial,
+                              spec: "gang_mod.GangSpec", block: List[int],
+                              members: List[int]) -> None:
+        """All member chips held: designate the lowest-chip member as
+        LEADER, stamp the gang geometry into the trial's info (it ships
+        with the TRIAL reply -> ctx.gang), and assign the trial to the
+        leader. Sched lock held."""
+        leader = min(members, key=self._chip_of)
+        info = {"chips": sorted(int(c) for c in block),
+                "members": sorted(int(m) for m in members),
+                "leader": int(leader), "mesh": dict(spec.mesh),
+                "strategy": spec.strategy}
+        with trial.lock:
+            trial.info_dict["gang"] = info
+        with self._store_lock:
+            self._gangs[tid] = dict(info)
+            if tid in self._gang_wait:
+                self._gang_wait.remove(tid)
+            if tid in self._requeue:
+                self._requeue.remove(tid)
+        trial.set_status(Trial.SCHEDULED)
+        self.server.reservations.assign_trial(leader, tid)
+        self.telemetry.trial_event(tid, "gang_assembled", partition=leader,
+                                   members=info["members"],
+                                   chips=info["chips"],
+                                   strategy=spec.strategy)
+        self.telemetry.trial_event(tid, "assigned", partition=leader)
+        self._log("gang assembled for trial {}: chips {} (leader runner "
+                  "{}, strategy {})".format(tid, info["chips"], leader,
+                                            spec.strategy))
+
+    def _release_gang(self, tid: str, why: str,
+                      partition: Optional[int] = None) -> None:
+        """Return a gang's chips to the pool: drop the member holds,
+        free the placer block, and journal the span edge. Idempotent —
+        callable from every terminal path (FINAL, error, preemption,
+        revocation, blacklist)."""
+        with self._store_lock:
+            info = self._gangs.pop(tid, None)
+        freed = self.server.reservations.release_gang(tid)
+        if self._placer is not None:
+            self._placer.release(tid, reason=why)
+        if info is None and not freed:
+            return
+        self.telemetry.trial_event(
+            tid, "gang_released", partition=partition,
+            members=(info or {}).get("members", freed), why=why)
+
+    def _sync_fleet_gang(self, active: bool) -> None:
+        """Keep the fleet-level contiguous-block reservation in step
+        with gang demand: while gang trials wait or run, the fleet
+        scheduler must route a contiguous runner block to THIS
+        experiment (and protect it from preemption); when the last gang
+        ends, the block goes back to fair share."""
+        binding = getattr(self.config, "fleet", None)
+        if binding is None or not hasattr(binding, "request_gang"):
+            return
+        with self._store_lock:
+            was = self._fleet_gang_active
+            self._fleet_gang_active = active
+        if active and not was:
+            got = binding.request_gang(
+                gang_mod.config_max_gang_chips(self.config))
+            if got is None:
+                # No disjoint window right now (other experiments hold
+                # blocks): stay un-latched so every subsequent demand
+                # tick retries instead of running gangs without their
+                # preemption-shielded block forever.
+                with self._store_lock:
+                    self._fleet_gang_active = False
+        elif was and not active:
+            binding.release_gang()
+
+    def gang_members(self, trial_id: str) -> List[int]:
+        """Members of an assembled gang (chaos's kill_gang_member picks
+        its victim here); empty when the trial has no assembled gang."""
+        with self._store_lock:
+            info = self._gangs.get(trial_id)
+            return list(info["members"]) if info else []
+
+    def _check_gang_members(self) -> None:
+        """Server event-loop scan: a silent member of an assembled gang
+        means the gang's mesh is broken — revoke the WHOLE gang exactly
+        once (the ``revoking`` flag dedupes rescans) via the worker
+        thread. A silent member of a still-assembling gang just loses
+        its hold so assembly re-plans around the dead chip."""
+        bound = self.server.hb_loss_timeout
+        if not self._gang_mode or bound is None:
+            return
+        res = self.server.reservations
+        with self._store_lock:
+            assembled = {tid: dict(info)
+                         for tid, info in self._gangs.items()
+                         if not info.get("revoking")}
+        for tid, info in assembled.items():
+            silent = [m for m in info["members"]
+                      if res.is_silent(m, bound)]
+            if not silent:
+                continue
+            with self._store_lock:
+                live = self._gangs.get(tid)
+                if live is None or live.get("revoking"):
+                    continue
+                live["revoking"] = True
+            self.enqueue({"type": "GANG_LOST", "trial_id": tid,
+                          "partition_id": silent[0]})
+        # Pre-assembly holds on dead runners: release them so the
+        # placer re-plans; the re-reserve path avoids dead chips.
+        with self._store_lock:
+            waiting = [tid for tid in self._gang_demand_locked()]
+        for tid in waiting:
+            for m in res.gang_members(tid):
+                if res.is_silent(m, bound):
+                    self._release_gang(tid, why="member_dead_assembling")
+                    break
+
+    def _gang_lost_msg_callback(self, msg) -> None:
+        """Worker-thread half of gang revocation: requeue the trial
+        EXACTLY once (reason ``gang_member_lost``), return the healthy
+        members to the pool, and abort the (possibly still computing)
+        leader through a reservation-level preempt STOP whose ack the
+        idempotent preemption path drops."""
+        tid = msg["trial_id"]
+        pid = msg.get("partition_id")
+        with self._sched_lock:
+            with self._store_lock:
+                info = self._gangs.get(tid)
+            trial = self.get_trial(tid)
+            if info is None or trial is None:
+                return
+            leader = info.get("leader")
+            self._release_gang(tid, why="member_lost", partition=pid)
+            self.server.reservations.clear_trial_if(leader, tid)
+            trial.reset_run_state()
+            with self._store_lock:
+                if tid not in self._requeue:
+                    self._requeue.append(tid)
+            self.result["gang_revocations"] = \
+                self.result.get("gang_revocations", 0) + 1
+            self.telemetry.trial_event(tid, "requeued", partition=pid,
+                                       reason="gang_member_lost")
+            self._log("gang member (runner {}) lost for trial {}; gang "
+                      "lease revoked, trial requeued".format(pid, tid))
+            if leader is not None and leader != pid:
+                # The leader is healthy but its mesh is gone: its next
+                # heartbeat draws STOP(preempt); the ack finds the trial
+                # already waiting and is dropped.
+                self.server.reservations.request_stop(leader, tid)
 
     # ------------------------------------------- pipelined hand-off (prefetch)
 
@@ -626,10 +975,14 @@ class OptimizationDriver(Driver):
             try:
                 refilled = self._refill_prefetch()
             except Exception as exc:  # noqa: BLE001 - mirror the worker contract
+                # Both flags before the (slow, I/O-bound) traceback log:
+                # anyone who observes the exception must already see the
+                # experiment marked done.
                 self.exception = exc
+                # unguarded-ok: monotonic completion latch, polled lock-free by design
+                self.experiment_done = True
                 self._log("suggester error: {}".format(
                     traceback.format_exc()))
-                self.experiment_done = True
                 return
             if not refilled:
                 self._suggest_wake.wait(constants.DRIVER_IDLE_REQUEUE_TICK_S)
@@ -796,6 +1149,13 @@ class OptimizationDriver(Driver):
 
     def _final_msg_locked(self, msg) -> None:
         self.add_executor_logs(msg.get("logs"))
+        # Any FINAL from this partition for this trial means the
+        # computation a gang-revocation STOP (Reservations.request_stop)
+        # was armed to abort has ended — consume it, or a stop orphaned
+        # by a raced FINAL (dropped as stale below) would persist and
+        # abort a healthy later re-run of the same trial on this runner.
+        self.server.reservations.pop_stop(msg["partition_id"],
+                                          msg.get("trial_id"))
         trial = self.get_trial(msg.get("trial_id"))
         if msg.get("preempted"):
             # A preemption ack is NOT a finalize: the trial goes back into
@@ -812,6 +1172,33 @@ class OptimizationDriver(Driver):
             # an undelivered one (the retry raced the hand-off): assigning
             # again would orphan that trial in the store and hang the
             # experiment's in-flight wait.
+            if self.server.reservations.get_assigned_trial(
+                    msg["partition_id"]) is None:
+                self._assign_next(msg["partition_id"], None)
+            return
+        msg_epoch = msg.get("epoch")
+        with trial.lock:
+            stale_epoch = msg_epoch is not None and \
+                int(msg_epoch) != trial.run_epoch
+        with self._store_lock:
+            waiting = trial.trial_id in self._requeue
+        if stale_epoch or (waiting and self.server.reservations
+                           .get_assigned_trial(msg["partition_id"])
+                           != trial.trial_id):
+            # The trial was revoked/requeued out from under this runner
+            # (gang member loss; a false loss detection) while its FINAL
+            # was in flight: the requeue is authoritative — drop the
+            # report and let the trial re-run. (A broken gang mesh could
+            # not have produced a healthy FINAL on real hardware; the
+            # CPU proxy would happily finalize it and the journal would
+            # then show a requeue with no re-assembly.) The epoch check
+            # catches what requeue-membership cannot: the dead run's
+            # FINAL arriving AFTER the trial was re-dispatched — even
+            # onto this same partition (a revoked gang reassembling onto
+            # its old leader).
+            self._log("dropping stale FINAL for requeued trial {} from "
+                      "runner {}".format(trial.trial_id,
+                                         msg["partition_id"]))
             if self.server.reservations.get_assigned_trial(
                     msg["partition_id"]) is None:
                 self._assign_next(msg["partition_id"], None)
@@ -836,6 +1223,12 @@ class OptimizationDriver(Driver):
         with self._store_lock:
             self._trial_store.pop(trial.trial_id, None)
             self._final_store.append(trial)
+        # A finalized gang trial frees its whole mesh slice: members
+        # return to the pool before the artifact dump below, so their
+        # idle ticks can pick up work while the leader persists.
+        self._release_gang(trial.trial_id,
+                           why="error" if was_error else "finalized",
+                           partition=msg.get("partition_id"))
         if trial.status == Trial.ERROR and self.controller.pruner is not None:
             report = getattr(self.controller.pruner, "report_failure", None)
             if report:
@@ -886,6 +1279,9 @@ class OptimizationDriver(Driver):
             msg = {**msg, "step": None}
         step = msg.get("step")
         trial.reset_run_state()
+        # A preempted gang trial releases its slice like any other
+        # terminal path; reassembly happens from the requeue backlog.
+        self._release_gang(trial.trial_id, why="preempted", partition=pid)
         with trial.lock:
             if step is not None:
                 trial.info_dict["resume_step"] = int(step)
@@ -1004,6 +1400,28 @@ class OptimizationDriver(Driver):
 
     def _assign_next_locked(self, partition_id: int,
                             last_trial: Optional[Trial]) -> None:
+        # Iterative on purpose: a gang suggestion parks for assembly and
+        # pulls the NEXT suggestion — an all-gang backlog must drain in
+        # a loop, not one recursion frame per parked trial (a ~1k-trial
+        # GANG-only sweep would blow the recursion limit).
+        while self._assign_next_once_locked(partition_id, last_trial):
+            last_trial = None
+
+    # locked-by: _sched_lock
+    def _assign_next_once_locked(self, partition_id: int,
+                                 last_trial: Optional[Trial]
+                                 ) -> Optional[bool]:
+        """One assignment attempt; True = pull again (the suggestion was
+        parked for gang assembly and this runner is still free)."""
+        # A gang-held member is not free: its chip belongs to an
+        # (assembling or running) gang's mesh slice. Keep its idle chain
+        # ticking so it resumes work the moment the gang releases. A
+        # FINAL-delivering runner is never held here — terminal paths
+        # release the gang before assigning next work.
+        if self._gang_mode and last_trial is None and \
+                self.server.reservations.gang_of(partition_id) is not None:
+            self._rearm_idle(partition_id)
+            return
         # Orphaned trials (lost runners) take priority over fresh
         # suggestions — but never swallow a FINAL report: when last_trial is
         # set the controller must see it (ASHA rung bookkeeping, pruner
@@ -1039,6 +1457,14 @@ class OptimizationDriver(Driver):
                 self._rearm_idle(partition_id)
             return
         if suggestion in (None, "IDLE"):
+            # Gang service first: a free runner whose chip sits inside a
+            # reserved block is conscripted here — skipped-but-retained
+            # for the gang instead of grabbing 1-chip work the block
+            # would then have to wait out. The idle chain stays armed:
+            # it is how the member resumes work after the gang releases.
+            if self._service_gangs_locked(partition_id):
+                self._rearm_idle(partition_id)
+                return
             cap = self.server.reservations.capacity(partition_id)
             parked = self._pop_parked(cap)
             if parked is not None:
@@ -1130,6 +1556,37 @@ class OptimizationDriver(Driver):
             # new run to a bracket slot) — persist so resume=True can pick
             # the bracket up mid-flight.
             self._checkpoint_pruner()
+            # Gang trials are never assigned to ONE runner: park the
+            # trial for assembly (the placer reserves a contiguous chip
+            # block; runners are conscripted as they free), then give
+            # THIS runner another turn — it may itself become the first
+            # conscript, else it takes the next (possibly 1-chip)
+            # suggestion.
+            spec = self._gang_spec_for(suggestion)
+            if spec is not None and spec.chips > 1:
+                with self._store_lock:
+                    if suggestion.trial_id not in self._gang_wait:
+                        self._gang_wait.append(suggestion.trial_id)
+                self._log("trial {} needs a {}-chip gang ({}); awaiting "
+                          "assembly".format(suggestion.trial_id, spec.chips,
+                                            spec.strategy))
+                if self._service_gangs_locked(partition_id):
+                    self._rearm_idle(partition_id)
+                    return None
+                return True  # runner still free: pull the next suggestion
+            # 1-chip work must not land on a runner whose chip is
+            # reserved for a waiting gang (the block would re-busy
+            # instead of draining): retain the suggestion in the backlog
+            # for an unreserved runner and conscript this one.
+            if self._gang_mode and self._placer is not None and \
+                    self._placer.owner_of(
+                        self._chip_of(partition_id)) is not None:
+                with self._store_lock:
+                    if suggestion.trial_id not in self._requeue:
+                        self._requeue.append(suggestion.trial_id)
+                self._service_gangs_locked(partition_id)
+                self._rearm_idle(partition_id)
+                return
             # Elastic sub-slices: a trial whose budget calls for a different
             # chip count than this runner is pinned to gets PARKED, and the
             # runner is told to exit + respawn at the right size (pinning
@@ -1234,6 +1691,7 @@ class OptimizationDriver(Driver):
         # Retire the suggester BEFORE the base teardown: a mid-wait
         # suggester must not refill from a stopping controller (and a
         # mid-fit one gets the join bound; it is a daemon either way).
+        # unguarded-ok: monotonic completion latch, polled lock-free by design
         self.experiment_done = True
         self._suggest_wake.set()
         t = self._suggester_thread

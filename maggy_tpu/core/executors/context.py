@@ -74,6 +74,21 @@ class TrialContext:
         return None if step is None else int(step)
 
     @property
+    def gang(self):
+        """For a gang-scheduled multi-chip trial: the assembled
+        ``maggy_tpu.gang.GangContext`` (member chips, mesh axes,
+        strategy, ``build_mesh()``/``sharding_env()`` helpers) the
+        driver stamped into the assignment info. None for 1-chip
+        trials — a train function can branch on it to run sharded or
+        single-device."""
+        info = self.info.get("gang")
+        if not info:
+            return None
+        from maggy_tpu.gang import GangContext
+
+        return GangContext(info)
+
+    @property
     def needs_fresh_state(self) -> bool:
         """True when this trial CONTINUES saved state — a preemption
         resume (``resume_step``) or an ASHA/Hyperband promotion

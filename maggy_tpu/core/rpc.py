@@ -264,6 +264,72 @@ class Reservations:
             if rec is not None:
                 rec["released"] = True
 
+    # ------------------------------------------------------------ gang holds
+
+    def hold_for_gang(self, partition_id, trial_id: str) -> None:
+        """Conscript the runner into a gang: while held it is not free —
+        the driver hands it no 1-chip work — but it keeps heartbeating
+        and idle-polling; its chip belongs to ``trial_id``'s mesh slice
+        until the gang releases."""
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            if rec is not None:
+                rec["gang"] = trial_id
+
+    def gang_of(self, partition_id) -> Optional[str]:
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            return rec.get("gang") if rec else None
+
+    def release_gang(self, trial_id: str) -> list:
+        """Free every member held for ``trial_id``; returns their pids so
+        the driver can restart their work loops."""
+        with self.lock:
+            freed = []
+            for pid, rec in self._table.items():
+                if rec.get("gang") == trial_id:
+                    rec.pop("gang", None)
+                    freed.append(pid)
+            return freed
+
+    def gang_members(self, trial_id: str) -> list:
+        with self.lock:
+            return sorted(pid for pid, rec in self._table.items()
+                          if rec.get("gang") == trial_id)
+
+    def free_pids(self) -> list:
+        """Runners available for new work: registered, unreleased, not
+        evicted, holding no trial and conscripted into no gang. The gang
+        assembler's free set."""
+        with self.lock:
+            return sorted(
+                pid for pid, rec in self._table.items()
+                if not rec.get("released") and not rec.get("evict")
+                and rec.get("trial_id") is None and rec.get("gang") is None)
+
+    def request_stop(self, partition_id, trial_id: str) -> None:
+        """Gang revocation: arm a one-shot preempt-STOP for the
+        partition's next heartbeat about ``trial_id``. Used to abort a
+        HEALTHY gang leader whose gang lost a member — the trial is
+        already requeued, so the leader's preempt ack is dropped by the
+        driver's idempotent preemption path and the runner returns to
+        the pool. Reservation-level (not a trial flag) so the abort
+        cannot be mistaken for a schedulable preemption."""
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            if rec is not None:
+                rec["stop_trial"] = trial_id
+
+    def pop_stop(self, partition_id, trial_id) -> bool:
+        """Consume an armed revocation STOP if it names ``trial_id``."""
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            if rec is not None and trial_id is not None \
+                    and rec.get("stop_trial") == trial_id:
+                rec.pop("stop_trial", None)
+                return True
+            return False
+
     def request_evict(self, partition_id) -> bool:
         """Fleet preemption: ask that this partition's runner be released
         from the experiment (GSTOP) at its next reply opportunity — after
@@ -918,6 +984,12 @@ class OptimizationServer(Server):
             telem.record_runner_stats(msg["partition_id"], rstats)
         self.driver.enqueue(dict(msg))
         trial_id = msg.get("trial_id")
+        if trial_id and self.reservations.pop_stop(msg["partition_id"],
+                                                  trial_id):
+            # Gang revocation abort: preempt-shaped so the runner acks
+            # and frees itself; the driver already requeued the trial.
+            return {"type": "STOP", "span": msg.get("span"),
+                    "preempt": True}
         stop = False
         if trial_id:
             trial = self.driver.get_trial(trial_id)
@@ -1013,6 +1085,10 @@ class OptimizationServer(Server):
         # true per-partition hand-off gaps from the trial.json artifacts.
         with trial.lock:
             trial.info_dict["partition"] = partition_id
+            # The run epoch rides in info so the FINAL can echo it: the
+            # driver drops a dead run's in-flight FINAL by epoch mismatch
+            # (same-partition re-dispatch makes partition checks blind).
+            trial.info_dict["epoch"] = trial.run_epoch
             info = dict(trial.info_dict)
         telem = self.telemetry
         if telem is not None:
@@ -1444,7 +1520,9 @@ class Client:
             # flags any key no handler reads.
             resp = self._request(
                 {"type": "FINAL", "trial_id": reporter.trial_id,
-                 "value": metric, "logs": data["logs"], **(extra or {})}
+                 "value": metric, "logs": data["logs"],
+                 "epoch": (self.last_info or {}).get("epoch"),
+                 **(extra or {})}
             )
             reporter.reset()
         self._handle_final_reply(resp)
@@ -1459,7 +1537,8 @@ class Client:
             data = reporter.get_data()
             resp = self._request(
                 {"type": "FINAL", "trial_id": trial_id, "value": None,
-                 "error": True, "logs": data["logs"]}
+                 "error": True, "logs": data["logs"],
+                 "epoch": (self.last_info or {}).get("epoch")}
             )
             reporter.reset()
         self._handle_final_reply(resp)

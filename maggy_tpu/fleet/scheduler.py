@@ -195,6 +195,13 @@ class FleetScheduler:
         # entries leave _entries so scheduling decisions stay O(live)
         # and a long-lived fleet host doesn't grow without bound.
         self._finished: List[Dict[str, Any]] = []  # guarded-by: _lock
+        # Gang-block reservations: experiment name -> contiguous fleet
+        # runner ids reserved for its gang-scheduled trials. A runner
+        # inside a block binds ONLY to the reserving experiment (and is
+        # protected from preemption), so the experiment's driver can
+        # assemble an N-chip contiguous mesh slice out of fleet runners
+        # without fair share starving the gang at N-1 members forever.
+        self._gang_blocks: Dict[str, List[int]] = {}  # guarded-by: _lock
         self._seq = itertools.count()
         self.stopped = False
 
@@ -251,6 +258,11 @@ class FleetScheduler:
                 return
             entry.state = state
             self._event("fleet_experiment", exp=entry.name, phase=state)
+            # A finished experiment's gang block must not park runners
+            # forever (the driver normally releases it, but a crashed
+            # driver may not have).
+            if self._gang_blocks.pop(entry.name, None) is not None:
+                self._event("pack", op="fleet_release", exp=entry.name)
             # Retire the entry: late release_binding calls still work on
             # the object itself; only the scheduling/status sets forget
             # it. Keep a bounded tail of final snapshots for status.json.
@@ -326,6 +338,62 @@ class FleetScheduler:
                 remaining -= used
         return targets
 
+    # ---------------------------------------------------------- gang blocks
+
+    def request_gang(self, entry: ExperimentEntry,
+                     size: int) -> Optional[List[int]]:
+        """Reserve a contiguous block of ``size`` fleet runners for
+        ``entry``'s gang-scheduled trials (topology-aware: lowest start
+        among windows disjoint from other experiments' blocks, preferring
+        size-aligned starts, fewest currently-bound-elsewhere runners so
+        the block drains fastest). Sticky until ``release_gang``; the
+        reservation both routes freed block runners to the experiment
+        and shields them from preemption sweeps."""
+        from maggy_tpu.gang import aligned_windows
+
+        size = int(size)
+        if size > self.fleet_size:
+            # Clamping would latch a too-small block and hang the
+            # experiment's gang demand forever — fail loudly instead.
+            raise ValueError(
+                "a gang of {} runners can never assemble on a {}-runner "
+                "fleet".format(size, self.fleet_size))
+        with self._lock:
+            existing = self._gang_blocks.get(entry.name)
+            if existing is not None:
+                return list(existing)
+            taken = {r for b in self._gang_blocks.values() for r in b}
+            bound_elsewhere = set()
+            for e in self._entries.values():
+                if e is not entry:
+                    bound_elsewhere |= set(e.open_leases.keys())
+            aligned = aligned_windows(self.fleet_size, size, taken)
+            if not aligned:
+                return None
+            block = min(aligned, key=lambda w: (
+                sum(1 for r in w if r in bound_elsewhere), w[0]))
+            self._gang_blocks[entry.name] = block
+            self._event("pack", op="fleet_reserve", exp=entry.name,
+                        block=block)
+            self._wake.notify_all()
+            return list(block)
+
+    def release_gang(self, entry: ExperimentEntry) -> None:
+        with self._lock:
+            block = self._gang_blocks.pop(entry.name, None)
+            if block is not None:
+                self._event("pack", op="fleet_release", exp=entry.name,
+                            block=block)
+                self._wake.notify_all()
+
+    # locked-by: _lock
+    def _gang_owner_locked(self, runner_idx: int
+                           ) -> Optional[ExperimentEntry]:
+        for name, block in self._gang_blocks.items():
+            if runner_idx in block:
+                return self._entries.get(name)
+        return None
+
     # -------------------------------------------------------------- binding
 
     def next_binding(self, runner_idx: int,
@@ -339,7 +407,7 @@ class FleetScheduler:
             while True:
                 if self.stopped:
                     return None
-                picked = self._pick_locked()
+                picked = self._pick_locked(runner_idx)
                 if picked is not None:
                     return self._lease_locked(runner_idx, picked)
                 if deadline is not None and time.monotonic() >= deadline:
@@ -347,7 +415,19 @@ class FleetScheduler:
                 self._wake.wait(timeout=0.2)
 
     # locked-by: _lock
-    def _pick_locked(self) -> Optional[ExperimentEntry]:
+    def _pick_locked(self, runner_idx: int) -> Optional[ExperimentEntry]:
+        # A runner inside a gang block binds ONLY to the reserving
+        # experiment — and bypasses the fair-share target (a gang needs
+        # its whole contiguous block SIMULTANEOUSLY; granting N-1 and
+        # fair-sharing the Nth would deadlock the gang). If the owner
+        # can't take it right now, the runner waits: binding it
+        # elsewhere would re-busy the block instead of draining it.
+        owner = self._gang_owner_locked(runner_idx)
+        if owner is not None:
+            if owner.wants_runners() and \
+                    owner.allocated() < owner.effective_max(self.fleet_size):
+                return owner
+            return None
         targets = self._targets_locked()
         now = time.monotonic()
         best = None
@@ -435,8 +515,15 @@ class FleetScheduler:
                 victim = self._victim_locked(e, targets)
                 if victim is None:
                     continue
-                runner, (pid, _t0) = max(victim.open_leases.items(),
-                                         key=lambda kv: kv[1][1])
+                # Never carve a runner out of the victim's own gang
+                # block: a mid-gang preemption would revoke the whole
+                # N-chip lease for a 1-runner rebalance.
+                protected = set(self._gang_blocks.get(victim.name) or ())
+                leases = [(r, v) for r, v in victim.open_leases.items()
+                          if r not in protected]
+                if not leases:
+                    continue
+                runner, (pid, _t0) = max(leases, key=lambda kv: kv[1][1])
                 if pid in victim.preempting_pids:
                     continue
                 victim.preempting_pids.add(pid)
@@ -567,6 +654,14 @@ class FleetBinding:
     def lease_pool(self, driver) -> "FleetLeasedPool":
         return FleetLeasedPool(self, driver)
 
+    def request_gang(self, size: int) -> Optional[List[int]]:
+        """Reserve a contiguous fleet-runner block for this experiment's
+        gang trials (see FleetScheduler.request_gang)."""
+        return self.fleet.scheduler.request_gang(self.entry, size)
+
+    def release_gang(self) -> None:
+        self.fleet.scheduler.release_gang(self.entry)
+
 
 class FleetLeasedPool(RunnerPool):
     """The driver-facing pool adapter in fleet mode: ``run`` registers the
@@ -618,6 +713,15 @@ class FleetLeasedPool(RunnerPool):
         if runner is None:
             return False
         return self.binding.fleet.pool.kill_worker(runner)
+
+    def chip_of(self, partition_id: int) -> Optional[int]:
+        """The fleet runner index (runner ≈ chip) this partition is
+        currently leased to — the gang placer's topology coordinate in
+        fleet mode, so 'contiguous chips' means contiguous FLEET runners
+        (the block ``FleetScheduler.request_gang`` reserves), not
+        per-experiment slot numbers. None while unbound."""
+        return self.binding.fleet.scheduler.runner_for(
+            self.binding.entry, partition_id)
 
     def terminate(self) -> None:
         # The fleet owns its runners; a doomed experiment must not take

@@ -1,0 +1,626 @@
+"""Gang-scheduled multi-chip trials: declaration, placement, replay.
+
+ROADMAP item 2 — the headline scenario. A trial may declare that it needs
+N chips plus a sharding plan (mesh axes + strategy), and the driver
+assembles a *gang* of N fleet runners (runner ≈ chip, the Podracer shape)
+into one contiguous mesh slice: every member's chip is leased to the
+trial, a designated leader runs the sharded train step through
+``parallel/mesh.py`` + ``parallel/sharding.py``, and the members hold
+their chips (idle-polling, heartbeating) until the gang releases. One
+sweep can therefore mix 1-chip CNN ASHA trials with N-chip sharded-LLM
+trials on the same fleet.
+
+Three pieces live here:
+
+- ``GangSpec`` — the declaration: chips, mesh axes ({"fsdp": 4} etc.,
+  derived from the strategy when omitted), and the strategy string the
+  model zoo's logical-axis rules understand (dp/fsdp/tp/sp/pp — see
+  ``parallel.sharding.logical_axis_rules``). Declared per budget via
+  ``config.chips_per_budget`` (int values stay 1-runner-per-trial
+  elastic sizing; GangSpec values gang-schedule) or searched over via a
+  ``Searchspace`` ``GANG`` entry.
+- ``GangPlacer`` — topology-aware packing (the perf substance): chips
+  form a line (consecutive ids = ICI-contiguous slice), gangs get
+  best-fit *aligned contiguous* blocks — the smallest free gap that
+  fits, at a start aligned to the gang size when the topology allows —
+  so mixed-size churn cannot strand chips between gangs. When free
+  chips >= need but no contiguous free window exists, the placer
+  journals a fragmentation ``stall`` and reserves the window with the
+  fewest busy chips so the block *drains* toward assembly instead of
+  waiting for luck. Every decision is a journaled ``pack`` event, so
+  packing efficiency is replayable offline.
+- ``replay_pack`` — pure replay of pack + gang span events into the
+  numbers the acceptance gate reads: chip-seconds utilization,
+  fragmentation stalls, and gang assembly latency p50/p95.
+
+``GangContext`` is what the leader's train function sees (``ctx.gang``):
+the member chips, a mesh over exactly those devices, and the strategy to
+hand to ``Trainer``/``shard_params``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+#: Conventional trial-parameter name for a Searchspace ``GANG`` entry.
+#: The driver resolves the entry by TYPE, so any name works; this is the
+#: name examples and docs use.
+GANG_PARAM = "gang"
+
+
+def default_mesh_for(strategy: str, chips: int) -> Dict[str, int]:
+    """Derive mesh axes from a strategy when the spec omits them: the
+    strategy's primary sharded axis gets all the chips. Composite
+    strategies ("fsdp_tp") must name axes explicitly — there is no one
+    right split."""
+    primary = {"dp": "data", "fsdp": "fsdp", "tp": "model", "sp": "seq",
+               "pp": "pipe", "ep": "expert", "zero": "data",
+               "dp_zero": "data"}
+    axis = primary.get(strategy)
+    if axis is None:
+        raise ValueError(
+            "GangSpec with strategy {!r} needs explicit mesh axes (only "
+            "single-part strategies {} derive a default)".format(
+                strategy, sorted(primary)))
+    return {axis: chips}
+
+
+class GangSpec:
+    """A trial's multi-chip declaration: ``chips`` fleet runners gang up
+    into a contiguous mesh slice shaped by ``mesh`` and sharded per
+    ``strategy``. Serializes to a plain dict so it can ride in trial
+    params / info over the fixed-schema msgpack wire."""
+
+    __slots__ = ("chips", "mesh", "strategy")
+
+    def __init__(self, chips: int, mesh: Optional[Dict[str, int]] = None,
+                 strategy: str = "dp"):
+        self.chips = int(chips)
+        if self.chips < 1:
+            raise ValueError("GangSpec.chips must be >= 1, got "
+                             "{}".format(chips))
+        from maggy_tpu.parallel.sharding import logical_axis_rules
+
+        logical_axis_rules(strategy)  # validates the strategy parts
+        self.strategy = strategy
+        if mesh is None:
+            mesh = default_mesh_for(strategy, self.chips) \
+                if self.chips > 1 else {"data": 1}
+        self.mesh = {str(k): int(v) for k, v in mesh.items()}
+        prod = 1
+        for v in self.mesh.values():
+            prod *= v
+        if prod != self.chips:
+            raise ValueError(
+                "GangSpec mesh {} multiplies to {} devices but chips={}"
+                .format(self.mesh, prod, self.chips))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"chips": self.chips, "mesh": dict(self.mesh),
+                "strategy": self.strategy}
+
+    @classmethod
+    def from_value(cls, value) -> "GangSpec":
+        """Normalize any declaration form — GangSpec, dict, or bare chip
+        count — into a GangSpec."""
+        if isinstance(value, GangSpec):
+            return value
+        if isinstance(value, dict):
+            return cls(value["chips"], mesh=value.get("mesh"),
+                       strategy=value.get("strategy", "dp"))
+        return cls(int(value))
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, GangSpec) and \
+            self.to_dict() == other.to_dict()
+
+    def __hash__(self) -> int:
+        return hash((self.chips, tuple(sorted(self.mesh.items())),
+                     self.strategy))
+
+    def __repr__(self) -> str:
+        return "GangSpec(chips={}, mesh={}, strategy={!r})".format(
+            self.chips, self.mesh, self.strategy)
+
+
+def spec_chips(value) -> int:
+    """Chip count of any chips_per_budget value (int or GangSpec/dict)."""
+    if isinstance(value, GangSpec):
+        return value.chips
+    if isinstance(value, dict):
+        return int(value.get("chips", 1))
+    return int(value)
+
+
+def config_max_gang_chips(config) -> int:
+    """Largest gang any trial of this config can declare: over the
+    chips_per_budget values and any Searchspace GANG entry. 1 = no
+    gangs."""
+    worst = 1
+    cpb = getattr(config, "chips_per_budget", None) or {}
+    for v in cpb.values():
+        worst = max(worst, spec_chips(v))
+    sp = getattr(config, "searchspace", None)
+    if sp is not None:
+        for name in sp.names():
+            if sp.get_type(name) == "GANG":
+                for v in sp.get(name):
+                    worst = max(worst, spec_chips(v))
+    return worst
+
+
+def config_declares_gangs(config) -> bool:
+    """Does this config declare any multi-runner gang (a GangSpec/dict
+    chips_per_budget value or a Searchspace GANG entry)? On the elastic
+    pool bare int chips_per_budget values size respawnable pinned
+    runners, not gangs; on every other pool a bare int N is the
+    documented shorthand for GangSpec(N) (config.py)."""
+    cpb = getattr(config, "chips_per_budget", None) or {}
+    if any(isinstance(v, (GangSpec, dict)) for v in cpb.values()):
+        return True
+    if getattr(config, "pool", "thread") != "elastic" \
+            and any(spec_chips(v) > 1 for v in cpb.values()):
+        return True
+    sp = getattr(config, "searchspace", None)
+    if sp is not None:
+        return any(sp.get_type(n) == "GANG" for n in sp.names())
+    return False
+
+
+class GangContext:
+    """What the gang leader's train function receives as ``ctx.gang``:
+    the assembled slice (chips + mesh axes + strategy) and helpers that
+    build the jax objects over exactly the gang's devices."""
+
+    def __init__(self, info: Dict[str, Any]):
+        self.chips: List[int] = [int(c) for c in info.get("chips", [])]
+        self.members: List[int] = [int(p) for p in info.get("members", [])]
+        self.leader: Optional[int] = info.get("leader")
+        self.mesh_shape: Dict[str, int] = dict(info.get("mesh", {}))
+        self.strategy: str = info.get("strategy", "dp")
+
+    @property
+    def size(self) -> int:
+        return len(self.chips)
+
+    def devices(self):
+        """The gang's jax devices, in chip order (runner ≈ chip: chip i
+        is ``jax.devices()[i]`` on an in-process fleet / CPU proxy)."""
+        import jax
+
+        devs = jax.devices()
+        return [devs[c] for c in self.chips]
+
+    def build_mesh(self):
+        """Named mesh over the gang's contiguous device slice."""
+        from maggy_tpu.parallel.mesh import slice_mesh
+
+        return slice_mesh(self.chips, self.mesh_shape)
+
+    def sharding_env(self):
+        from maggy_tpu.parallel.mesh import ShardingEnv
+
+        return ShardingEnv(self.build_mesh())
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"chips": list(self.chips), "members": list(self.members),
+                "leader": self.leader, "mesh": dict(self.mesh_shape),
+                "strategy": self.strategy}
+
+
+# ------------------------------------------------------------------ placer
+
+
+def contiguous_windows(total: int, size: int,
+                       taken: Set[int]) -> List[List[int]]:
+    """Every contiguous ``size``-chip window on a ``total``-chip line
+    that avoids ``taken`` — the one shared piece of topology geometry."""
+    return [list(range(s, s + size))
+            for s in range(0, total - size + 1)
+            if not any(c in taken for c in range(s, s + size))]
+
+
+def aligned_windows(total: int, size: int,
+                    taken: Set[int]) -> List[List[int]]:
+    """``contiguous_windows`` preferring size-ALIGNED starts when any
+    exist (aligned blocks tile: two 4-gangs on 8 chips can never strand
+    2+2 chips between them). ``GangPlacer`` and
+    ``FleetScheduler.request_gang`` both select from these windows, each
+    with its own cost key."""
+    windows = contiguous_windows(total, size, taken)
+    return [w for w in windows if w[0] % size == 0] or windows
+
+
+class GangPlacer:
+    """Topology-aware packer: assigns gangs best-fit aligned contiguous
+    chip blocks and journals every decision as a ``pack`` event.
+
+    The chip line models the pod slice (consecutive ids = ICI
+    neighbors). Placement policy, in order:
+
+    1. among fully FREE windows, pick the best fit (the one inside the
+       smallest maximal free run: big free runs are preserved for bigger
+       gangs), size-aligned starts first (aligned blocks tile, so two
+       4-gangs on 8 chips can never strand 2+2 chips between them) —
+       but a free UNALIGNED window still beats waiting on a busy chip;
+    2. if no free window exists but enough chips are free in total, that
+       is a FRAGMENTATION STALL — journaled — and the gang reserves the
+       (aligned-preferred) window with the fewest busy chips so the
+       block drains toward assembly as those trials finish;
+    3. if fewer than ``size`` chips are free at all, the same
+       fewest-busy window is reserved (gang scheduling: members are
+       conscripted as they free up).
+
+    Reserved chips are excluded from other gangs' windows; the driver
+    additionally stops handing 1-chip work to runners inside a reserved
+    block (skipped-but-retained), which is what makes the reservation
+    drain instead of churn.
+    """
+
+    def __init__(self, total_chips: int, telemetry=None):
+        self.total_chips = int(total_chips)
+        self.telemetry = telemetry
+        self._lock = threading.Lock()
+        # key (trial id) -> ordered chip block. A reservation persists
+        # from reserve() until release(): reserved -> assembled is the
+        # driver's business, the placer only owns the geometry.
+        self._blocks: Dict[str, List[int]] = {}  # guarded-by: _lock
+        self.stalls = 0  # guarded-by: _lock
+        self._event("pack", op="init", chips=self.total_chips)
+
+    def _event(self, kind: str, **fields: Any) -> None:
+        telem = self.telemetry
+        if telem is not None:
+            telem.event(kind, **fields)
+
+    def block_of(self, key: str) -> Optional[List[int]]:
+        with self._lock:
+            block = self._blocks.get(key)
+            return list(block) if block is not None else None
+
+    def reserved_chips(self) -> Set[int]:
+        with self._lock:
+            return {c for block in self._blocks.values() for c in block}
+
+    def owner_of(self, chip: int) -> Optional[str]:
+        """Which gang (trial id) reserved this chip, or None."""
+        with self._lock:
+            for key, block in self._blocks.items():
+                if chip in block:
+                    return key
+        return None
+
+    def reserve(self, key: str, size: int, free: Set[int],
+                avoid: Optional[Set[int]] = None) -> Optional[List[int]]:
+        """Reserve a contiguous ``size``-chip block for gang ``key``.
+        ``free`` is the set of chips idle right now (registered, no
+        trial, no hold); ``avoid`` chips are DEAD (silent/released
+        runners) and excluded from every window — a block containing a
+        chip that can never free would park the gang forever. Returns
+        the block (existing reservations are sticky), or None when no
+        admissible window exists."""
+        with self._lock:
+            existing = self._blocks.get(key)
+            if existing is not None:
+                return list(existing)
+            taken = {c for k, b in self._blocks.items() for c in b}
+            taken |= set(avoid or ())
+            free = (set(free) - taken) & set(range(self.total_chips))
+            block, stalled = self._choose_locked(size, free, taken)
+            if block is None:
+                return None
+            self._blocks[key] = block
+            if stalled:
+                self.stalls += 1
+                self._event("pack", op="stall", gang=key, need=size,
+                            free=len(free))
+            self._event("pack", op="reserve", gang=key, block=block,
+                        free=sorted(free & set(block)),
+                        busy=sorted(set(block) - free))
+            return list(block)
+
+    # locked-by: _lock
+    def _choose_locked(self, size: int, free: Set[int],
+                       taken: Set[int]) -> Tuple[Optional[List[int]], bool]:
+        windows = contiguous_windows(self.total_chips, size, taken)
+        if not windows:
+            return None, False
+        aligned = [w for w in windows if w[0] % size == 0] or windows
+
+        # Best fit: the free window whose surrounding maximal free run
+        # is smallest (preserve big runs for bigger gangs).
+        def run_len(w):
+            lo = w[0]
+            while lo - 1 in free and lo - 1 not in taken:
+                lo -= 1
+            hi = w[-1]
+            while hi + 1 in free and hi + 1 not in taken:
+                hi += 1
+            return hi - lo + 1
+
+        # A fully free window assembles NOW: aligned windows tile best,
+        # but a free UNALIGNED window still beats stalling behind a busy
+        # chip inside an aligned one.
+        for cands in (aligned, windows):
+            free_runs = [w for w in cands if all(c in free for c in w)]
+            if free_runs:
+                return min(free_runs,
+                           key=lambda w: (run_len(w), w[0])), False
+        # No fully free window anywhere: reserve the aligned-preferred
+        # one with fewest busy chips (it drains fastest). A fragmentation
+        # stall is the specific case where enough chips are free overall
+        # but scattered.
+        stalled = len(free) >= size
+        best = min(aligned,
+                   key=lambda w: (sum(1 for c in w if c not in free), w[0]))
+        return best, stalled
+
+    def release(self, key: str, reason: str = "released") -> None:
+        with self._lock:
+            block = self._blocks.pop(key, None)
+        if block is not None:
+            self._event("pack", op="release", gang=key, block=block,
+                        why=reason)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"chips": self.total_chips, "stalls": self.stalls,
+                    "blocks": {k: list(b) for k, b in self._blocks.items()}}
+
+
+# ------------------------------------------------------------------ replay
+
+
+def replay_pack(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Pure replay of one journal's packing record: chip-seconds
+    utilization over the sweep window, fragmentation stalls, and gang
+    assembly latency. Same journal, same numbers — bench.py's
+    ``detail.pack`` is exactly this call.
+
+    Busy accounting: a gang trial occupies ``len(chips)`` chips from its
+    ``gang_assembled`` edge to ``gang_released``; a 1-chip trial
+    occupies one from ``running`` to ``finalized``. The window is the
+    experiment's first-busy to last-idle edge, so an empty tail doesn't
+    dilute the number.
+    """
+    from maggy_tpu.telemetry.spans import _dist_stats
+
+    chips_total = None
+    stalls = 0
+    reserves = 0
+    gang_open: Dict[str, Tuple[float, int]] = {}
+    busy_intervals: List[Tuple[float, float, int]] = []  # (t0, t1, width)
+    run_open: Dict[str, float] = {}
+    gang_trials: Set[str] = set()
+    waiting_since: Dict[str, float] = {}
+    assembly_ms: List[float] = []
+    gangs_assembled = 0
+    for ev in events:
+        kind, t = ev.get("ev"), ev.get("t")
+        if kind == "pack":
+            op = ev.get("op")
+            if op == "init" and ev.get("chips") is not None:
+                chips_total = int(ev["chips"])
+            elif op == "stall":
+                stalls += 1
+            elif op == "reserve":
+                reserves += 1
+                if ev.get("gang") is not None and t is not None:
+                    waiting_since.setdefault(ev["gang"], t)
+            continue
+        if kind != "trial" or t is None:
+            continue
+        trial, phase = ev.get("trial"), ev.get("phase")
+        if trial is None:
+            continue
+        if phase == "gang_assembled":
+            gang_trials.add(trial)
+            gangs_assembled += 1
+            width = len(ev.get("chips") or ev.get("members") or []) or 1
+            gang_open[trial] = (t, width)
+            t0 = waiting_since.pop(trial, None)
+            if t0 is not None:
+                assembly_ms.append((t - t0) * 1e3)
+        elif phase == "gang_released":
+            opened = gang_open.pop(trial, None)
+            if opened is not None:
+                busy_intervals.append((opened[0], t, opened[1]))
+        elif phase == "running":
+            run_open.setdefault(trial, t)
+        elif phase == "finalized":
+            t0 = run_open.pop(trial, None)
+            if t0 is not None and trial not in gang_trials:
+                busy_intervals.append((t0, t, 1))
+    # A journal ending mid-gang (crash) still counts the open interval.
+    last_t = max([t1 for _, t1, _ in busy_intervals] or [0.0])
+    for trial, (t0, width) in gang_open.items():
+        busy_intervals.append((t0, max(t0, last_t), width))
+    out: Dict[str, Any] = {
+        "chips": chips_total,
+        "gangs_assembled": gangs_assembled,
+        "fragmentation_stalls": stalls,
+        "reservations": reserves,
+        "assembly_latency": _dist_stats(assembly_ms),
+    }
+    if busy_intervals and chips_total:
+        w0 = min(t0 for t0, _, _ in busy_intervals)
+        w1 = max(t1 for _, t1, _ in busy_intervals)
+        busy = sum((t1 - t0) * width for t0, t1, width in busy_intervals)
+        if w1 > w0:
+            out["window_s"] = round(w1 - w0, 3)
+            out["busy_chip_seconds"] = round(busy, 3)
+            out["chip_seconds_utilization"] = round(
+                busy / (chips_total * (w1 - w0)), 3)
+    return out
+
+
+# -------------------------------------------------------------- pack soak
+
+
+def gang_train_fn(lr, budget=1, gang=None, reporter=None, ctx=None):
+    """The mixed-sweep gang trial: a tiny sharded MLP trained through
+    ``parallel/mesh.py`` + ``parallel/sharding.py`` over the gang's
+    contiguous device slice (1-chip trials run the same program on one
+    device). Deterministic in (lr, gang shape) and independent of WHICH
+    chips the placer picked, so a gang trial's final loss is directly
+    comparable to the single-process sharded reference — the MULTICHIP
+    dryrun parity check. ``budget`` only selects the gang size (via
+    chips_per_budget); it does not scale the work, so mixed-size trials
+    have comparable durations and the utilization number reflects
+    packing, not workload skew."""
+    del budget, gang  # gang geometry arrives through ctx.gang
+    g = ctx.gang.to_dict() if ctx is not None and ctx.gang is not None \
+        else None
+    return {"metric": reference_gang_loss(lr, g, reporter=reporter)}
+
+
+def reference_gang_loss(lr, gang: Optional[Dict[str, Any]] = None,
+                        reporter=None, steps: int = 4) -> float:
+    """Single-process sharded reference: the exact computation a gang
+    leader runs, callable standalone (same mesh axes over the leading
+    jax devices) so tests can assert gang-vs-reference parity to
+    numerical tolerance."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from maggy_tpu.parallel.mesh import make_mesh
+    from maggy_tpu.parallel.sharding import batch_sharding, shard_params
+
+    if gang and isinstance(gang.get("chips"), list):
+        # A GangContext dict: mesh over exactly those chips.
+        devs = [jax.devices()[c] for c in gang["chips"]]
+        mesh = make_mesh(dict(gang.get("mesh") or {}), devices=devs)
+        strategy = gang.get("strategy", "dp")
+    else:
+        spec = GangSpec.from_value(gang) if gang else GangSpec(1)
+        devs = jax.devices()[:spec.chips]
+        mesh = make_mesh(spec.mesh, devices=devs)
+        strategy = spec.strategy
+    rng = np.random.default_rng(0)
+    w1 = jnp.asarray(rng.normal(size=(16, 32)) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(32, 16)) * 0.1, jnp.float32)
+    params = {"w1": w1, "w2": w2}
+    x = jnp.asarray(rng.normal(size=(8 * 4, 16)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(8 * 4, 16)), jnp.float32)
+    tx = optax.sgd(float(lr))
+    with mesh:
+        shardings = shard_params(mesh, params, strategy)
+        params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, s), params, shardings)
+        batch_sh = batch_sharding(mesh, ndim=2)
+        x = jax.device_put(x, batch_sh)
+        y = jax.device_put(y, batch_sh)
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def step(params, opt_state, x, y):
+            def loss_fn(p):
+                h = jnp.tanh(x @ p["w1"])
+                return jnp.mean((h @ p["w2"] - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state2 = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state2, loss
+
+        loss = None
+        for i in range(steps):
+            params, opt_state, loss = step(params, opt_state, x, y)
+            if reporter is not None:
+                reporter.broadcast(-loss, step=i)
+                # Pace the trial so heartbeats land mid-trial and mixed
+                # sizes have comparable durations (packing soak realism).
+                _time.sleep(0.04)
+        return -float(loss)
+
+
+def run_pack_soak(num_trials: int = 12, gang_chips: int = 4,
+                  workers: int = 8, base_dir: Optional[str] = None,
+                  seed: int = 7,
+                  utilization_gate: float = 0.7) -> Dict[str, Any]:
+    """The acceptance scenario: one mixed ASHA sweep — rung-0 trials on
+    1 chip, promotions on ``gang_chips``-chip fsdp gangs — on a
+    ``workers``-runner thread fleet over the 8-fake-device CPU proxy.
+    The budget axis selects the gang size via ``chips_per_budget``
+    (GangSpec values), exactly the headline "1-chip CNN ASHA trials +
+    N-chip sharded trials on one fleet" shape. Returns the
+    journal-replayed pack report plus the parity check (every gang
+    trial's final loss vs the single-process sharded reference) and the
+    invariant verdicts (no scheduling deadlock = experiment completed;
+    chip-seconds utilization >= ``utilization_gate``)."""
+    import glob
+    import json as _json
+    import os
+    import tempfile
+
+    import jax
+
+    from maggy_tpu import OptimizationConfig, Searchspace, experiment
+    from maggy_tpu.optimizers import Asha
+    from maggy_tpu.telemetry import JOURNAL_NAME, read_events
+
+    if jax.device_count() < workers:
+        raise RuntimeError(
+            "pack soak needs >= {} jax devices (runner ≈ chip by index) "
+            "but the backend has {}; set XLA_FLAGS=--xla_force_host_"
+            "platform_device_count={} before jax initializes".format(
+                workers, jax.device_count(), workers))
+
+    base_dir = base_dir or tempfile.mkdtemp(prefix="maggy_pack_")
+    chips_map = {1: GangSpec(1),
+                 gang_chips: GangSpec(gang_chips, strategy="fsdp")}
+    config = OptimizationConfig(
+        name="pack_soak", num_trials=num_trials,
+        optimizer=Asha(reduction_factor=gang_chips, resource_min=1,
+                       resource_max=gang_chips, seed=seed),
+        searchspace=Searchspace(lr=("DOUBLE", [0.05, 0.2])),
+        direction="max", num_workers=workers, pool="thread",
+        hb_interval=0.05, seed=seed, es_policy="none",
+        chips_per_budget=chips_map,
+        experiment_dir=base_dir,
+    )
+    result = experiment.lagom(gang_train_fn, config)
+    exp_dirs = sorted(d for d in glob.glob(os.path.join(base_dir, "*"))
+                      if os.path.isdir(d))
+    journal = os.path.join(exp_dirs[-1], JOURNAL_NAME)
+    events = read_events(journal)
+    pack = replay_pack(events)
+    # Parity: each finalized gang trial's metric vs the sharded
+    # single-process reference for its declared gang shape.
+    parity = []
+    for td in glob.glob(os.path.join(exp_dirs[-1], "*", "trial.json")):
+        with open(td) as f:
+            d = _json.load(f)
+        budget = (d.get("params") or {}).get("budget")
+        spec = chips_map.get(budget)
+        if d.get("final_metric") is None or spec is None or spec.chips <= 1:
+            continue
+        ref = reference_gang_loss(d["params"]["lr"], spec.to_dict())
+        parity.append({"trial": d.get("id"),
+                       "metric": d["final_metric"], "reference": ref,
+                       "abs_err": abs(d["final_metric"] - ref)})
+    violations: List[str] = []
+    if not result.get("num_trials"):
+        violations.append("sweep finalized zero trials")
+    util = pack.get("chip_seconds_utilization")
+    if util is None or util < utilization_gate:
+        violations.append(
+            "chip-seconds utilization {} below the {} gate".format(
+                util, utilization_gate))
+    for p in parity:
+        if p["abs_err"] > 1e-4:
+            violations.append(
+                "gang/reference divergence on {}: |{} - {}| = {}".format(
+                    p["trial"], p["metric"], p["reference"], p["abs_err"]))
+    if pack.get("gangs_assembled", 0) < 1:
+        violations.append("no gang trial ever assembled")
+    if not parity:
+        violations.append("no finalized gang trial to parity-check")
+    return {"ok": not violations, "violations": violations, "pack": pack,
+            "parity": parity, "journal": journal,
+            "result": {"num_trials": result.get("num_trials"),
+                       "best_val": result.get("best_val")}}

@@ -71,7 +71,9 @@ class TPE(BaseAsyncBO):
     def _n_categories(self):
         sp = self.searchspace
         return [
-            len(sp._hparams[name]) if t in (Searchspace.DISCRETE, Searchspace.CATEGORICAL) else 0
+            len(sp._hparams[name])
+            if t in (Searchspace.DISCRETE, Searchspace.CATEGORICAL,
+                     Searchspace.GANG) else 0
             for name, t in sp._hparam_types.items()
         ]
 

@@ -1,9 +1,9 @@
-from maggy_tpu.parallel.mesh import ShardingEnv, make_mesh
+from maggy_tpu.parallel.mesh import ShardingEnv, make_mesh, slice_mesh
 from maggy_tpu.parallel.sharding import shard_params, batch_sharding, param_sharding
 from maggy_tpu.parallel.pipeline import (
     PipelinedLM, pipeline_1f1b_grads, pipeline_apply, stage_param_sharding)
 from maggy_tpu.parallel.ulysses import ulysses_attention
 
-__all__ = ["ShardingEnv", "make_mesh", "shard_params", "batch_sharding",
+__all__ = ["ShardingEnv", "make_mesh", "slice_mesh", "shard_params", "batch_sharding",
            "param_sharding", "PipelinedLM", "pipeline_1f1b_grads",
            "pipeline_apply", "stage_param_sharding", "ulysses_attention"]

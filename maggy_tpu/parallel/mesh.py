@@ -48,6 +48,35 @@ def make_mesh(mesh_shape: Dict[str, int], devices: Optional[Sequence] = None):
     return jax.sharding.Mesh(arr, tuple(shape.keys()))
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions: the public spelling when
+    present, else ``jax.experimental.shard_map`` with ``check_vma``
+    mapped to its older ``check_rep`` name."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as sm
+
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=check_vma)
+
+
+def slice_mesh(chip_ids: Sequence[int], mesh_shape: Dict[str, int]):
+    """Named mesh over a slice of the global device inventory, by device
+    index. This is the gang-scheduling mesh constructor: the driver
+    assembles runners whose chips are CONSECUTIVE indices (the placer's
+    contiguity invariant — consecutive ids model ICI neighbors), and the
+    leader builds the trial's mesh over exactly that slice."""
+    import jax
+
+    devs = jax.devices()
+    return make_mesh(dict(mesh_shape),
+                     devices=[devs[int(c)] for c in chip_ids])
+
+
 @dataclass
 class ShardingEnv:
     """What a distributed train function gets instead of a DDP model wrapper.

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from maggy_tpu.parallel.mesh import shard_map as version_shard_map
+
 
 def pipeline_apply(
     stage_fn: Callable,
@@ -93,7 +95,7 @@ def pipeline_apply(
         x_spec = P(None, data_axes, *([None] * (x_mb.ndim - 2)))
     else:
         x_spec = P()
-    out = jax.shard_map(
+    out = version_shard_map(
         local_fn, mesh=mesh,
         in_specs=(stage_spec, x_spec), out_specs=x_spec,
         check_vma=False,
@@ -272,7 +274,7 @@ def pipeline_1f1b_grads(
         tgt_spec = P(None, data_axes, *([None] * (t_mb.ndim - 2)))
     else:
         mb_spec, tgt_spec = P(), P()
-    return jax.shard_map(
+    return version_shard_map(
         local_fn, mesh=mesh,
         in_specs=(stage_spec, mb_spec, tgt_spec),
         out_specs=(P(), stage_spec),

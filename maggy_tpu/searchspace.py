@@ -35,6 +35,13 @@ class Searchspace:
     - ``INTEGER``: integer range, ``(low, high)`` inclusive with ``low < high``
     - ``DISCRETE``: explicit list of numeric values
     - ``CATEGORICAL``: explicit list of string values
+    - ``GANG``: explicit list of multi-chip trial shapes
+      (``maggy_tpu.gang.GangSpec`` instances or their dict form) — the
+      sweep searches over chip count / mesh axes / sharding strategy,
+      and the driver gang-schedules each sampled shape onto the fleet.
+      Index-encoded like CATEGORICAL for BO surrogates; stored (and
+      delivered to the train function) as plain dicts so trial params
+      stay wire- and JSON-serializable.
 
     Construct with kwargs or :meth:`add`::
 
@@ -47,8 +54,9 @@ class Searchspace:
     INTEGER = "INTEGER"
     DISCRETE = "DISCRETE"
     CATEGORICAL = "CATEGORICAL"
+    GANG = "GANG"
 
-    _TYPES = (DOUBLE, DOUBLE_LOG, INTEGER, DISCRETE, CATEGORICAL)
+    _TYPES = (DOUBLE, DOUBLE_LOG, INTEGER, DISCRETE, CATEGORICAL, GANG)
     # Continuous kinds (shared by optimizers for guards/perturbations).
     CONTINUOUS_TYPES = (DOUBLE, DOUBLE_LOG, INTEGER)
 
@@ -111,6 +119,13 @@ class Searchspace:
                     raise ValueError(
                         "CATEGORICAL values of '{}' must be strings, got {!r}.".format(name, v)
                     )
+        elif hp_type == Searchspace.GANG:
+            from maggy_tpu.gang import GangSpec
+
+            # Normalize every entry through GangSpec (validating chips/
+            # mesh/strategy) and STORE the dict form: trial params must
+            # stay msgpack/JSON-serializable end to end.
+            region = [GangSpec.from_value(v).to_dict() for v in region]
         self._hparam_types[name] = hp_type
         self._hparams[name] = region
 

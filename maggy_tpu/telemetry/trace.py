@@ -24,6 +24,11 @@ Mapping:
 - **counters**: runner-stats memory/RTT samples become ``C`` counter
   events per partition (``rss_mb``, ``hb_rtt_ms``), so a leaking trial is
   a visibly climbing line under its track.
+- **gang lanes**: an assembled gang (``gang_assembled`` →
+  ``gang_released``) renders one identical slice on every member
+  partition's ``gang`` lane, so an N-chip gang is a grouped band across N
+  contiguous partition tracks; placer decisions (``pack`` events —
+  reserve/stall/release) are instant markers on the driver track.
 
 The exporter is pure (events in, dict out) and the journal is the only
 input — any soak/bench artifact can be rendered after the fact.
@@ -52,7 +57,13 @@ _SUB_SLICES = (
 _INSTANT_PHASES = ("suggested", "queued", "stop_flagged", "stop_sent",
                    "requeued", "lost", "profile_skipped", "prefetch_hit",
                    "prefetch_miss", "preempt_requested", "preempted",
-                   "resumed")
+                   "resumed", "gang_assembled", "gang_released")
+
+#: tid of the per-partition gang lane: a gang trial's busy interval is
+#: rendered as one slice on EVERY member partition's gang lane, so the
+#: assembled block is visible as a grouped band across the contiguous
+#: partition tracks (the trial's own slice stays on the leader's tid 0).
+GANG_TID = 1
 
 #: ttfm-breakdown fields of a ``compiled`` event, rendered (in runtime
 #: order) as sequential sub-slices inside the attempt's ``startup`` window
@@ -101,6 +112,15 @@ def build_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                         "ts": us(t), "pid": _pid(pid), "tid": 0,
                         "args": {k: v for k, v in ev.items()
                                  if k not in ("ev", "t", "stacks")}})
+        elif kind == "pack":
+            # Placer decisions (reserve/stall/release) on the driver
+            # track: a fragmentation stall is a visible marker exactly
+            # where the timeline shows scattered free chips.
+            out.append({"name": "pack:{}".format(ev.get("op")),
+                        "cat": "pack", "ph": "i", "s": "p",
+                        "ts": us(t), "pid": DRIVER_PID, "tid": 0,
+                        "args": {k: v for k, v in ev.items()
+                                 if k not in ("ev", "t")}})
         elif kind == "runner_stats" and pid is not None:
             for counter in ("rss_mb", "hb_rtt_ms"):
                 if ev.get(counter) is not None:
@@ -128,6 +148,32 @@ def build_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                             "args": {k: v for k, v in ev.items()
                                      if k not in ("ev", "t")}})
 
+    # Gang lanes: each assembled gang renders one slice per MEMBER
+    # partition (gang lane, tid GANG_TID) spanning gang_assembled ->
+    # gang_released, so an N-chip gang is a grouped band across N
+    # contiguous partition tracks — packing (and fragmentation) is
+    # literally visible. A journal ending mid-gang closes the band at
+    # the last event.
+    last_us = max((us(e["t"]) for e in events
+                   if isinstance(e.get("t"), (int, float))), default=0)
+    gang_parts = set()
+    for trial_id, evs in by_trial.items():
+        open_gang = None
+        for ev in evs:
+            phase = ev.get("phase")
+            if phase == "gang_assembled":
+                open_gang = ev
+            elif phase == "gang_released" and open_gang is not None:
+                out.extend(_gang_band(trial_id, open_gang, us(ev["t"]),
+                                      us, gang_parts))
+                open_gang = None
+        if open_gang is not None:
+            out.extend(_gang_band(trial_id, open_gang, last_us, us,
+                                  gang_parts))
+    # Idle-held members may never emit an event of their own — their
+    # tracks exist because a gang band lands on them.
+    partitions |= gang_parts
+
     # Track naming metadata: driver + one process per partition, sorted so
     # Perfetto lists partition 0..N in order.
     meta = [{"name": "process_name", "ph": "M", "pid": DRIVER_PID, "tid": 0,
@@ -139,12 +185,40 @@ def build_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                      "tid": 0, "args": {"name": "partition {}".format(p)}})
         meta.append({"name": "process_sort_index", "ph": "M", "pid": _pid(p),
                      "tid": 0, "args": {"sort_index": p}})
+        if p in gang_parts:
+            meta.append({"name": "thread_name", "ph": "M", "pid": _pid(p),
+                         "tid": GANG_TID, "args": {"name": "gang"}})
+            meta.append({"name": "thread_sort_index", "ph": "M",
+                         "pid": _pid(p), "tid": GANG_TID,
+                         "args": {"sort_index": GANG_TID}})
     out.sort(key=lambda e: e.get("ts", 0))
     return {"traceEvents": meta + out, "displayTimeUnit": "ms",
             "otherData": {"source": "maggy_tpu.telemetry",
                           "t0_unix_s": t0,
                           "partitions": sorted(partitions),
                           "trials": len(by_trial)}}
+
+
+def _gang_band(trial_id: str, assembled: Dict[str, Any], end_us: int,
+               us, gang_parts: set) -> List[dict]:
+    """One gang's grouped band: an identical slice on every member
+    partition's gang lane, from the assembled edge to ``end_us``."""
+    out: List[dict] = []
+    start = us(assembled["t"])
+    members = assembled.get("members") or []
+    name = "gang {} x{} ({})".format(
+        trial_id[:8], len(members) or "?",
+        assembled.get("strategy", "?"))
+    args = {"trial": trial_id, "members": list(members),
+            "chips": assembled.get("chips"),
+            "leader": assembled.get("partition"),
+            "strategy": assembled.get("strategy")}
+    for m in members:
+        gang_parts.add(int(m))
+        out.append({"name": name, "cat": "gang", "ph": "X", "ts": start,
+                    "dur": max(1, end_us - start), "pid": _pid(int(m)),
+                    "tid": GANG_TID, "args": args})
+    return out
 
 
 def _trial_slices(trial_id: str, evs: List[Dict[str, Any]], us) -> List[dict]:

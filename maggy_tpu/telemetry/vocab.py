@@ -27,6 +27,11 @@ SPAN_PHASES = (
     "stop_flagged", "stop_sent", "finalized", "lost", "requeued",
     "profile_skipped", "prefetch_hit", "prefetch_miss",
     "preempt_requested", "preempted", "resumed", "compiled",
+    # Gang scheduling (maggy_tpu.gang): the trial's contiguous chip
+    # block became fully held and the leader was dispatched / the
+    # block's chips returned to the pool (fields: members, chips; the
+    # pair brackets the trial's N-chip busy interval in replay_pack).
+    "gang_assembled", "gang_released",
 )
 
 #: Top-level journal event kinds (the ``ev`` field).
@@ -48,6 +53,8 @@ EVENT_KINDS = frozenset({
     "fleet_experiment",       # per-experiment fleet lifecycle
     "lease",                  # runner lease start/end (phase: LEASE_PHASES)
     "preempt",                # fleet preemption decision
+    "pack",                   # gang placer decision (op: init/reserve/
+                              #   stall/release — maggy_tpu.gang)
 })
 
 #: ``reason=`` on a trial ``requeued`` phase: why it re-entered the
@@ -57,6 +64,8 @@ REQUEUE_REASONS = frozenset({
     "heartbeat_loss",   # runner went silent holding the trial (LOST path)
     "dead_partition",   # fresh suggestion rerouted off a dead runner
     "preempted",        # graceful scheduler preemption (resume-capable)
+    "gang_member_lost",  # a gang member died: whole lease revoked, the
+                         # trial reassembles a fresh gang (exactly once)
 })
 
 #: ``phase=`` per non-trial event kind.
@@ -76,6 +85,7 @@ LEASE_END_REASONS = frozenset({"released", "error"})
 #: checked without importing the chaos engine).
 CHAOS_KINDS = frozenset({
     "kill_runner", "stall_runner", "fake_preemption", "preempt_trial",
+    "kill_gang_member",
     "drop_msg", "delay_msg", "sever_conn", "env_write_fail",
 })
 

@@ -89,6 +89,76 @@ def init_train_state(
     return params, opt_state, shardings
 
 
+def _reinit_wrapper(entry):
+    """The donating re-init program: fresh VALUES from the entry's
+    initializer, written into the retired trial's DONATED memory."""
+    init_unboxed = entry.init_unboxed
+
+    def reinit(r, old):
+        del old  # donated: recycled memory, fresh values
+        return init_unboxed(r)
+
+    return jax.jit(reinit, out_shardings=entry.shardings,
+                   donate_argnums=(1,))
+
+
+def _ensure_reinit(entry):
+    """The entry's donating re-init, lazily built (once, under the build
+    lock) when neither the prebuild thread nor an earlier trial already
+    has. A consumer arriving while the prebuild is mid-compile waits on
+    the lock and gets the prebuilt executable instead of compiling its
+    own."""
+    fn = entry.reinit_jit
+    if fn is not None:
+        return fn
+    with entry.reinit_lock:
+        if entry.reinit_jit is None:
+            entry.reinit_jit = _reinit_wrapper(entry)
+        return entry.reinit_jit
+
+
+def _prebuild_reinit_async(entry, rng) -> None:
+    """AOT-compile the donating re-init on a background thread,
+    overlapping the program family's FIRST (cold) trial — so the first
+    WARM trial's init() finds the program ready instead of paying its
+    one-time trace+compile (the init_ms spike). Lowering is against
+    ABSTRACT inputs (ShapeDtypeStructs carrying the entry's shardings),
+    so the prebuild allocates no device memory next to the live trial's
+    state. Strictly an optimization: any failure — including the
+    compiled executable later rejecting a call — leaves the lazy inline
+    path (and its fresh-init fallback) intact.
+    ``MAGGY_TPU_PREBUILD_REINIT=0`` disables it."""
+    import os as _os
+
+    if _os.environ.get("MAGGY_TPU_PREBUILD_REINIT", "1") == "0" \
+            or entry.abstract is None:
+        return
+
+    def target():
+        from maggy_tpu.train import warm as _warm
+
+        try:
+            rng_abs = jax.ShapeDtypeStruct(rng.shape, rng.dtype)
+            old_abs = jax.tree_util.tree_map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                  sharding=s),
+                entry.abstract, entry.shardings)
+            with entry.reinit_lock:
+                if entry.reinit_jit is not None:
+                    return
+                entry.reinit_jit = _reinit_wrapper(entry).lower(
+                    rng_abs, old_abs).compile()
+                entry.reinit_prebuilt = True
+            _warm._count("reinit_prebuilds")
+        except Exception:  # noqa: BLE001 - prebuild is an optimization
+            pass
+
+    import threading as _threading
+
+    _threading.Thread(target=target, daemon=True,
+                      name="reinit-prebuild").start()
+
+
 def _init_state_via_slot(slot, model, tx, rng, example_inputs, mesh,
                          strategy, init_kwargs, allow_buffers: bool = True):
     """Warm-slot init: get-or-build the per-input-shape init entry (jitted
@@ -117,7 +187,7 @@ def _init_state_via_slot(slot, model, tx, rng, example_inputs, mesh,
             return {k: v for k, v in variables.items() if k != "losses"}
 
         abstract = jax.eval_shape(init_fn, rng)
-        _, shardings = _unbox_and_specs(abstract, mesh, strategy)
+        plain_abstract, shardings = _unbox_and_specs(abstract, mesh, strategy)
 
         def init_unboxed(r):
             plain, _ = _unbox_and_specs(init_fn(r), mesh, strategy)
@@ -125,9 +195,16 @@ def _init_state_via_slot(slot, model, tx, rng, example_inputs, mesh,
 
         return _warm._InitEntry(
             jax.jit(init_unboxed, out_shardings=shardings), init_unboxed,
-            shardings)
+            shardings, abstract=plain_abstract)
 
     entry, hit = slot.init_entry(ikey, build)
+    if not hit and allow_buffers and slot.key is not None:
+        # First trial of a shared program family: compile the donating
+        # re-init CONCURRENTLY with the trial (ROADMAP item 3 follow-up),
+        # so the family's first WARM trial no longer pays its one-time
+        # trace+compile inside init() — the init_ms spike the journal's
+        # ttfm breakdown shows today.
+        _prebuild_reinit_async(entry, rng)
     family = _warm.opt_family(tx)
     if allow_buffers:
         retired = entry.take_retired()
@@ -139,19 +216,17 @@ def _init_state_via_slot(slot, model, tx, rng, example_inputs, mesh,
         if retired is not None:
             old_vars, old_opt, old_family = retired
             try:
-                if entry.reinit_jit is None:
-                    init_unboxed = entry.init_unboxed
-
-                    def reinit(r, old):
-                        del old  # donated: recycled memory, fresh values
-                        return init_unboxed(r)
-
-                    entry.reinit_jit = jax.jit(
-                        reinit, out_shardings=entry.shardings,
-                        donate_argnums=(1,))
-                params = entry.reinit_jit(rng, old_vars)
+                params = _ensure_reinit(entry)(rng, old_vars)
             except Exception:  # noqa: BLE001 - donation is an optimization
                 params = None
+                # A PREBUILT executable that rejects concrete calls
+                # (layout/sharding mismatch vs its abstract lowering)
+                # must not shadow the lazy jit path forever: evict it so
+                # the next trial rebuilds inline and donation recovers.
+                with entry.reinit_lock:
+                    if entry.reinit_prebuilt:
+                        entry.reinit_jit = None
+                        entry.reinit_prebuilt = False
             if params is not None and family is not None \
                     and old_family == family:
                 try:

@@ -293,14 +293,25 @@ class _InitEntry:
     reuse), the lazily built donating re-init, and the single retired
     buffer cell the next trial consumes."""
 
-    __slots__ = ("init_jit", "init_unboxed", "shardings", "reinit_jit",
+    __slots__ = ("init_jit", "init_unboxed", "shardings", "abstract",
+                 "reinit_jit", "reinit_lock", "reinit_prebuilt",
                  "opt_tx", "opt_family", "opt_reinit_jit", "retired", "lock")
 
-    def __init__(self, init_jit, init_unboxed, shardings):
+    def __init__(self, init_jit, init_unboxed, shardings, abstract=None):
         self.init_jit = init_jit
         self.init_unboxed = init_unboxed
         self.shardings = shardings
+        # Unboxed abstract state tree (ShapeDtypeStructs) — what the
+        # background re-init prebuild lowers against, so it never touches
+        # device memory.
+        self.abstract = abstract
         self.reinit_jit = None
+        # Serializes the donating re-init build between the concurrent
+        # prebuild thread (spawned with the family's FIRST trial) and the
+        # first WARM trial's inline fallback: one trace+compile, the
+        # loser waits on the winner's program.
+        self.reinit_lock = threading.Lock()
+        self.reinit_prebuilt = False
         # First transform of the family seen on this entry: its (pure)
         # init is what the donating opt re-init traces; the per-trial
         # hyperparam values are rebound after.

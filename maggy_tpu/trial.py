@@ -60,6 +60,12 @@ class Trial:
         self.metric_dict: Dict[int, float] = {}  # guarded-by: lock
         self.start: Optional[float] = None  # guarded-by: lock
         self.duration: Optional[float] = None  # guarded-by: lock
+        # Run epoch: bumped on every reset_run_state (requeue/revocation)
+        # and stamped into each dispatch, so the driver can tell a dead
+        # run's in-flight FINAL from the live re-run's — even when both
+        # come from the SAME partition (a revoked gang reassembling onto
+        # its old leader).
+        self.run_epoch = 0  # guarded-by: lock
         self.info_dict: Dict[str, Any] = info_dict or {}
         self.lock = threading.RLock()
 
@@ -120,6 +126,7 @@ class Trial:
             self.early_stop = False
             self.preempt = False
             self.final_metric = None
+            self.run_epoch += 1
             self.metric_history = []
             self.step_history = []
             self.metric_dict = {}

@@ -739,6 +739,7 @@ class TestFinalPayloadConformance:
         from maggy_tpu.core import rpc
 
         c = object.__new__(rpc.Client)
+        c.last_info = {"epoch": 3}
         c._request = lambda msg, **kw: (sent.update(msg), {"type": "OK"})[1]
         c._handle_final_reply = lambda resp: None
         return c
@@ -756,6 +757,9 @@ class TestFinalPayloadConformance:
         assert sent["trial_id"] == "t1"
         assert sent["value"] == 0.7
         assert "span" not in sent
+        # The run-epoch echo IS read (the driver's stale-FINAL guard
+        # drops a dead run's FINAL by epoch mismatch) — not a dead key.
+        assert sent["epoch"] == 3
 
     def test_error_and_preempt_finals_conform_too(self):
         from maggy_tpu.core.reporter import Reporter
