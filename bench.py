@@ -739,7 +739,13 @@ def chaos_main():
     report = run_soak(seed=seed,
                       num_trials=int(os.environ.get("BENCH_CHAOS_TRIALS",
                                                     "12")),
-                      lock_witness=True)
+                      lock_witness=True,
+                      # Invariant 9: the obs endpoints must stay
+                      # responsive while runners are killed and replies
+                      # severed — the soak doubles as the kill-side obs
+                      # responsiveness check (the stall side lives in
+                      # the tier-1 obs soak test).
+                      obs=True)
     print(json.dumps({
         "metric": "chaos soak (kill+preempt+drop+sever, journal-checked)",
         "value": 1.0 if report["ok"] else 0.0,
@@ -752,6 +758,7 @@ def chaos_main():
             "recoveries": report["recoveries"],
             "trials": report["trials"],
             "health": report.get("health"),
+            "obs": report.get("obs"),
             "client_retries": report["client_retries"],
             "journal": report["journal"],
             # The soak timeline (chaos injections + health flags as
@@ -853,6 +860,158 @@ def pack_main():
         },
     }), flush=True)
     return 0 if report["ok"] else 1
+
+
+def _obs_train_fn(lr, units, reporter=None):
+    """Obs-bench trial: pure-python, deterministic, a few broadcast
+    steps — the sweep exists to put live load on the scrape path, not to
+    measure training."""
+    import time as _time
+
+    acc = 1.0 / (1.0 + abs(lr - 0.1) + units / 1e4)
+    for step in range(4):
+        reporter.broadcast(acc * (step + 1) / 4.0, step=step)
+        _time.sleep(0.02)
+    return {"metric": acc}
+
+
+def obs_main():
+    """``bench.py --obs``: observability-plane scrape bench (see
+    maggy_tpu/telemetry/obs.py). Runs a small sweep with the obs server
+    on (ephemeral port) while a scraper polls /metrics + /status +
+    /healthz at ~30 Hz, and prints one JSON line whose detail.obs block
+    carries per-route scrape latency p50/p95 under live load plus a
+    scrape-vs-journal consistency verdict: every scraped finalized-count
+    sample must sit between the journal-replayed counts bracketing the
+    scrape's wall time. Always a CPU proxy (the plane under test is
+    platform-independent Python; pinning the platform keeps rounds
+    comparable per the ROADMAP flaky-TPU note — detail.platform records
+    it). Exit 1 if the endpoints fail, stall, or disagree with the
+    journal."""
+    if "MAGGY_TPU_BASE_DIR" not in os.environ:
+        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    for var in _ACCEL_BOOTSTRAP_VARS:
+        os.environ.pop(var, None)
+    import glob
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from maggy_tpu import OptimizationConfig, Searchspace, experiment
+    from maggy_tpu.telemetry import JOURNAL_NAME, obs, read_events
+    from maggy_tpu.telemetry.spans import _dist_stats
+
+    seed = int(os.environ.get("BENCH_OBS_SEED", "7"))
+    trials = int(os.environ.get("BENCH_OBS_TRIALS", "10"))
+    t0 = time.time()
+    lat = {"/metrics": [], "/status": [], "/healthz": []}
+    samples = []  # (wall_t, finalized count scraped from /metrics)
+    failures = []
+    healthz_bad = 0
+    stop = threading.Event()
+
+    def scraper():
+        base = None
+        while not stop.is_set():
+            server = obs.active_server()
+            if server is None:
+                if base is not None:
+                    return
+                time.sleep(0.01)
+                continue
+            if base is None:
+                base = "http://{}:{}".format(*server.address)
+            try:
+                bodies = {}
+                for route in ("/metrics", "/status", "/healthz"):
+                    r0 = time.monotonic()
+                    try:
+                        bodies[route] = urllib.request.urlopen(
+                            base + route, timeout=5).read().decode()
+                    except urllib.error.HTTPError as e:
+                        # /healthz legitimately answers 503 (counted —
+                        # this fault-free sweep must never be
+                        # unhealthy); an error status on any OTHER
+                        # route is a broken endpoint, not a scrape.
+                        if route != "/healthz":
+                            raise
+                        bodies[route] = e.read().decode()
+                        nonlocal_count["healthz_bad"] += 1
+                    lat[route].append((time.monotonic() - r0) * 1e3)
+                wall = time.time()
+                count = 0
+                for line in bodies["/metrics"].splitlines():
+                    if line.startswith("maggy_tpu_trial_phase_total") \
+                            and 'phase="finalized"' in line:
+                        count = int(float(line.rsplit(" ", 1)[1]))
+                samples.append((wall, count))
+            except Exception as e:  # noqa: BLE001 - the failure IS the finding
+                if obs.active_server() is not None:
+                    failures.append(repr(e))
+            time.sleep(0.03)
+
+    nonlocal_count = {"healthz_bad": 0}
+    thread = threading.Thread(target=scraper, daemon=True)
+    thread.start()
+    config = OptimizationConfig(
+        name="bench_obs", num_trials=trials, optimizer="randomsearch",
+        searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2]),
+                                units=("INTEGER", [8, 64])),
+        direction="max", num_workers=2, hb_interval=0.05, seed=seed,
+        es_policy="none", obs_port=0)
+    result = experiment.lagom(_obs_train_fn, config)
+    stop.set()
+    thread.join(timeout=5)
+    healthz_bad = nonlocal_count["healthz_bad"]
+
+    exp_dirs = sorted(d for d in glob.glob(os.path.join(
+        os.environ["MAGGY_TPU_BASE_DIR"], "*")) if os.path.isdir(d))
+    journal = os.path.join(exp_dirs[-1], JOURNAL_NAME)
+    events = read_events(journal)
+    fin_times = sorted(e["t"] for e in events
+                       if e.get("ev") == "trial"
+                       and e.get("phase") == "finalized")
+    # Scrape-vs-journal: a live counter read at wall time T must agree
+    # with the journal replayed to T, up to clock/step slack either side.
+    slack = 0.5
+    mismatches = []
+    for wall, count in samples:
+        lo = sum(1 for t in fin_times if t <= wall - slack)
+        hi = sum(1 for t in fin_times if t <= wall + slack)
+        if not lo <= count <= hi:
+            mismatches.append({"t": wall, "scraped": count,
+                               "journal_bounds": [lo, hi]})
+    ok = bool(samples) and not failures and not mismatches \
+        and healthz_bad == 0 and result.get("num_trials") == trials
+    print(json.dumps({
+        "metric": "obs scrape (live /metrics+/status+/healthz under a "
+                  "{}-trial sweep, journal-checked)".format(trials),
+        "value": 1.0 if ok else 0.0,
+        "unit": "scrape_consistent",
+        "detail": {
+            "obs": {
+                "scrapes": len(samples),
+                "failures": failures,
+                "healthz_not_ok": healthz_bad,
+                "scrape_ms": {route.strip("/"): _dist_stats(vals)
+                              for route, vals in lat.items()},
+                "consistency": {"samples": len(samples),
+                                "mismatches": mismatches,
+                                "slack_s": slack,
+                                "journal_finalized": len(fin_times),
+                                "last_scraped": samples[-1][1]
+                                if samples else None},
+            },
+            "platform": "cpu proxy (forced; the obs plane is "
+                        "platform-independent — pinned for "
+                        "cross-round comparability)",
+            "seed": seed,
+            "wall_s": round(time.time() - t0, 1),
+            "journal": journal,
+        },
+    }), flush=True)
+    return 0 if ok else 1
 
 
 def extra_main(name):
@@ -1297,4 +1456,6 @@ if __name__ == "__main__":
         sys.exit(fleet_main())
     if "--pack" in sys.argv:
         sys.exit(pack_main())
+    if "--obs" in sys.argv:
+        sys.exit(obs_main())
     sys.exit(main())

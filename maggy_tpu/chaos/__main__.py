@@ -95,6 +95,13 @@ def main(argv=None) -> int:
     ap.add_argument("--show-schedule", action="store_true",
                     help="print the plan's deterministic decision "
                          "expansion and exit (no experiment)")
+    ap.add_argument("--obs", action="store_true",
+                    help="run the soak with the observability plane on "
+                         "(invariant 9): a concurrent scraper asserts "
+                         "/metrics + /status + /healthz stay responsive "
+                         "and truthful under the faults, and a stalled "
+                         "partition's first health flag must journal "
+                         "exactly one profile_captured artifact")
     ap.add_argument("--no-witness", action="store_true",
                     help="disable the runtime lock-order witness "
                          "(maggy_tpu.analysis.witness; on by default so "
@@ -170,7 +177,7 @@ def main(argv=None) -> int:
     report = harness.run_soak(
         plan=plan, seed=plan.seed, train_fn=train_fn,
         num_trials=args.trials, workers=args.workers, pool=args.pool,
-        lock_witness=not args.no_witness, **soak_kwargs)
+        lock_witness=not args.no_witness, obs=args.obs, **soak_kwargs)
     print(json.dumps(report, indent=2, default=str))
     return 0 if report["ok"] else 1
 

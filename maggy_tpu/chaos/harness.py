@@ -59,6 +59,18 @@ checkable against any soak artifact after the fact):
     double-finalized. A trial that outran detection is the benign
     completed_before_detection outcome. ``gang_plan``, ``python -m
     maggy_tpu.chaos --gang``.
+9.  **The observability plane survives the faults** — with
+    ``run_soak(obs=True)`` the experiment runs with the obs HTTP server
+    on (config.obs_port=0) while a scraper polls /metrics, /status and
+    /healthz throughout the soak: every scrape after the server comes up
+    must answer (a stalled runner or a killed worker must never wedge
+    the endpoints — they read only lock-brief snapshots), /healthz must
+    report 503 while a stall flag is active (the plane reports
+    TRUTHFULLY under duress), and — via ``check_invariants`` over the
+    journal — the first straggler/hang flag per stalled partition must
+    have produced exactly ONE ``profile_captured`` artifact (the
+    health-triggered capture fires once per partition, bounded by the
+    run-wide rate limit).
 """
 
 from __future__ import annotations
@@ -75,6 +87,49 @@ from maggy_tpu.chaos.plan import FaultPlan, FaultSpec
 #: the benign completed_before_detection outcome.
 _REQUEUE_KINDS = ("kill_runner", "fake_preemption", "preempt_trial",
                   "kill_gang_member")
+
+
+def _obs_scrape_loop(stop_evt, stats: Dict[str, Any]) -> None:
+    """Soak-side scraper (invariant 9): poll every obs route until the
+    soak ends, recording latency, failures, and whether /healthz ever
+    reported unhealthy. A failure only counts while the process obs
+    server is still up — the teardown race at experiment end is not a
+    responsiveness violation."""
+    import json as _json
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    from maggy_tpu.telemetry import obs as obs_mod
+
+    base = None
+    while not stop_evt.is_set():
+        server = obs_mod.active_server()
+        if server is None:
+            if base is not None:
+                return  # server came and went: the experiment is over
+            _time.sleep(0.01)
+            continue
+        if base is None:
+            base = "http://{}:{}".format(*server.address)
+        t0 = _time.monotonic()
+        try:
+            urllib.request.urlopen(base + "/metrics", timeout=5).read()
+            body = urllib.request.urlopen(base + "/status", timeout=5).read()
+            stats["last_status"] = _json.loads(body)
+            try:
+                urllib.request.urlopen(base + "/healthz", timeout=5).read()
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    stats["unhealthy_seen"] += 1
+                else:
+                    raise
+            stats["scrape_ms"].append((_time.monotonic() - t0) * 1e3)
+            stats["scrapes"] += 1
+        except Exception as e:  # noqa: BLE001 - every failure mode is the finding
+            if obs_mod.active_server() is not None:
+                stats["failures"].append(repr(e))
+        _time.sleep(0.03)
 
 
 def default_plan(seed: int = 7) -> FaultPlan:
@@ -284,7 +339,8 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
              base_dir: Optional[str] = None,
              requeue_grace_s: float = 5.0,
              config_overrides: Optional[Dict[str, Any]] = None,
-             lock_witness: Optional[bool] = None
+             lock_witness: Optional[bool] = None,
+             obs: bool = False
              ) -> Dict[str, Any]:
     """Execute one soak and return its report (see ``check_invariants``).
 
@@ -303,8 +359,16 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
     violations. ``None`` defers to MAGGY_TPU_LOCK_WITNESS (the chaos
     CLI passes True by default). Installation happens before the driver
     builds its locks; if this call installed the witness (rather than
-    finding it already active), it uninstalls on the way out."""
+    finding it already active), it uninstalls on the way out.
+
+    ``obs`` arms invariant 9: the soak runs with the observability
+    server on (an ephemeral port unless config_overrides says
+    otherwise) and a concurrent scraper; the report gains an ``obs``
+    block and any unresponsive endpoint, untruthful /healthz, or
+    missing/duplicated health-triggered ``profile_captured`` artifact
+    is a violation."""
     import tempfile
+    import threading
 
     from maggy_tpu.analysis import witness as _witness
 
@@ -332,8 +396,20 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
         seed=seed, es_policy="none", experiment_dir=base_dir,
         chaos=plan,
     )
+    if obs:
+        kwargs["obs_port"] = 0
     kwargs.update(config_overrides or {})
     config = OptimizationConfig(**kwargs)
+    obs_stats: Dict[str, Any] = {"scrapes": 0, "failures": [],
+                                 "scrape_ms": [], "unhealthy_seen": 0,
+                                 "last_status": None}
+    obs_stop = threading.Event()
+    obs_thread = None
+    if obs:
+        obs_thread = threading.Thread(
+            target=_obs_scrape_loop, args=(obs_stop, obs_stats),
+            daemon=True, name="chaos-obs-scraper")
+        obs_thread.start()
     # Bound for invariant 5 (stall -> health flag): the WORST-case hang
     # threshold (startup window, in case the plan stalls a trial before
     # its first metric) + health-check interval + grace for the
@@ -357,6 +433,9 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
     try:
         result = experiment.lagom(train_fn, config)
     finally:
+        if obs_thread is not None:
+            obs_stop.set()
+            obs_thread.join(timeout=5)
         if wit is not None and wit_installed_here \
                 and not _witness.enabled_by_env():
             _witness.uninstall()
@@ -393,6 +472,35 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
             "best-trial mismatch: result.best_val={} but max finalized "
             "trial metric on disk is {}".format(best, max(metrics)))
         report["ok"] = False
+    if obs:
+        # Invariant 9, live half: the endpoints answered throughout the
+        # soak and /healthz told the truth while the fleet was degraded
+        # (the journal half — profile_captured — lives in
+        # check_invariants).
+        from maggy_tpu.telemetry.spans import _dist_stats
+
+        report["obs"] = {
+            "scrapes": obs_stats["scrapes"],
+            "failures": obs_stats["failures"],
+            "scrape_ms": _dist_stats(obs_stats["scrape_ms"]),
+            "unhealthy_seen": obs_stats["unhealthy_seen"],
+        }
+        if obs_stats["scrapes"] == 0:
+            report["violations"].append(
+                "obs endpoints never answered: the soak scraped zero "
+                "successful /metrics+/status+/healthz rounds")
+        if obs_stats["failures"]:
+            report["violations"].append(
+                "obs endpoints unresponsive under faults: {} scrape "
+                "failure(s), first: {}".format(
+                    len(obs_stats["failures"]), obs_stats["failures"][0]))
+        stalled = report["faults"]["by_kind"].get("stall_runner", 0)
+        if stalled and report["health"]["raised"] > 0 \
+                and obs_stats["unhealthy_seen"] == 0:
+            report["violations"].append(
+                "obs healthz untruthful: health flags were raised during "
+                "the stall soak but /healthz never reported 503")
+        report["ok"] = not report["violations"]
     report.update(
         journal=journal, result={"num_trials": result.get("num_trials"),
                                  "best_val": result.get("best_val"),
@@ -442,11 +550,19 @@ def check_invariants(events: List[Dict[str, Any]],
     health_by_check: Dict[str, int] = {}
     health_engine_ran = False
     experiment_finalized = False
+    obs_armed = False
+    profile_captures: List[Dict[str, Any]] = []
     for ev in events:
         kind = ev.get("ev")
         t = ev.get("t")
         if kind == "chaos":
             chaos_events.append(dict(ev))
+            continue
+        if kind == "obs_started":
+            obs_armed = True
+            continue
+        if kind == "profile_captured":
+            profile_captures.append(dict(ev))
             continue
         if kind == "health":
             if ev.get("check") == "engine":
@@ -692,6 +808,43 @@ def check_invariants(events: List[Dict[str, Any]],
                 "t={:.3f} produced no health straggler/hang flag within "
                 "{:.1f}s".format(pid, t0, stall_flag_bound_s))
 
+    # Invariant 9, journal half: with the obs plane armed, the FIRST
+    # straggler/hang flag per stalled partition yields exactly ONE
+    # health-triggered profile artifact — zero means the capture hook
+    # never fired, more than one means the per-partition dedup (or the
+    # run-wide rate limit) is broken.
+    from maggy_tpu.telemetry.profiling import AUTO_CAPTURE_LIMIT
+
+    auto_captures = [p for p in profile_captures
+                     if p.get("reason") == "auto"]
+    if len(auto_captures) > AUTO_CAPTURE_LIMIT:
+        violations.append(
+            "profile rate limit broken: {} auto captures journaled "
+            "(limit {})".format(len(auto_captures), AUTO_CAPTURE_LIMIT))
+    if obs_armed and enforce_stall:
+        stalled_pids = []
+        for ce in chaos_events:
+            if ce.get("kind") == "stall_runner" \
+                    and ce.get("partition") is not None \
+                    and ce["partition"] not in stalled_pids:
+                stalled_pids.append(ce["partition"])
+        flagged_pids = {f["partition"] for f in stall_flags
+                        if f.get("flagged")}
+        for pid in stalled_pids:
+            captures = [p for p in auto_captures
+                        if p.get("partition") == pid]
+            if len(captures) > 1:
+                violations.append(
+                    "duplicate profile capture: stalled partition {} "
+                    "journaled {} auto profile_captured events (expected "
+                    "exactly 1)".format(pid, len(captures)))
+            elif not captures and pid in flagged_pids \
+                    and len(auto_captures) < AUTO_CAPTURE_LIMIT:
+                violations.append(
+                    "missing profile capture: stalled partition {} was "
+                    "health-flagged but journaled no profile_captured "
+                    "artifact".format(pid))
+
     by_kind: Dict[str, int] = {}
     for ce in chaos_events:
         by_kind[ce["kind"]] = by_kind.get(ce["kind"], 0) + 1
@@ -709,6 +862,9 @@ def check_invariants(events: List[Dict[str, Any]],
                    "raised": len(health_raised),
                    "by_check": health_by_check,
                    "stall_flags": stall_flags},
+        "profiles": {"obs_armed": obs_armed,
+                     "captured": len(profile_captures),
+                     "auto": len(auto_captures)},
     }
 
 

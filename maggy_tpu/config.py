@@ -10,6 +10,7 @@ reference infers it from Spark dynamic-allocation settings,
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Sequence, Union
 
@@ -58,6 +59,22 @@ class LagomConfig:
     #: never see — still surface.
     health_hang_factor: float = 25.0
 
+    #: Live observability plane (maggy_tpu.telemetry.obs): an HTTP server
+    #: exposing GET /metrics (Prometheus text format), /status (TELEM
+    #: snapshot + live trial-store/reservation/gang/fleet state),
+    #: /healthz (200/503 from the health engine's raised findings) and
+    #: /profilez (on-demand jax.profiler capture). None (the default) =
+    #: OFF: no socket is opened and behavior is bit-for-bit unchanged.
+    #: 0 = bind an ephemeral port (journaled as an ``obs_started`` event
+    #: so tools can discover it). Also armable without touching code via
+    #: MAGGY_TPU_OBS_PORT. One obs server per process — a second
+    #: experiment in the same process joins the first one's listener.
+    obs_port: Optional[int] = None
+    #: Obs bind host. Loopback by default: the endpoints are
+    #: unauthenticated (Prometheus-style), so exposing them beyond the
+    #: host is an explicit operator decision.
+    obs_host: str = "127.0.0.1"
+
     #: Shared-fleet attachment (maggy_tpu.fleet): a FleetBinding placed
     #: here by ``experiment.lagom_submit`` / ``Fleet.submit`` makes the
     #: driver LEASE runners from the fleet scheduler (weighted fair share,
@@ -67,6 +84,15 @@ class LagomConfig:
     #: classic single-tenant behavior bit-for-bit — ``lagom()`` is simply
     #: a fleet of one that owns its pool.
     fleet: Any = None
+
+    def resolved_obs_port(self) -> Optional[int]:
+        """The observability server port to bind, or None for off: the
+        explicit ``obs_port`` field when set, else MAGGY_TPU_OBS_PORT
+        (empty/unparsable = off). The ONE home of this resolution — the
+        drivers and the fleet both consult it."""
+        if self.obs_port is not None:
+            return int(self.obs_port)
+        return resolved_env_obs_port()
 
     def resolved_hb_loss_timeout(self) -> float:
         """Seconds of heartbeat silence before a runner/worker is
@@ -79,6 +105,17 @@ class LagomConfig:
             return float(explicit)
         return max(self.hb_loss_min_s,
                    self.hb_interval * self.hb_loss_factor)
+
+
+def resolved_env_obs_port() -> Optional[int]:
+    """MAGGY_TPU_OBS_PORT as an int, or None when unset/empty/garbage."""
+    raw = os.environ.get("MAGGY_TPU_OBS_PORT", "").strip()
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
 
 
 @dataclass

@@ -127,6 +127,47 @@ class Driver(ABC):
             self.health.attach(reservations=self.server.reservations)
             self.telemetry.health = self.health
             self.health.start()
+        # Live observability plane (maggy_tpu.telemetry.obs): /metrics,
+        # /status, /healthz, /profilez over the process-wide HTTP server.
+        # OFF unless config.obs_port / MAGGY_TPU_OBS_PORT names a port —
+        # with it unset, no socket is opened and nothing below runs. When
+        # on, the health engine additionally gains the auto-capture hook:
+        # the first straggler/hang raise per partition yields a device
+        # profile + thread dump under exp_dir/profiles/, journaled as a
+        # ``profile_captured`` event.
+        self.obs_registration = None
+        self.profiler = None
+        obs_port = None
+        resolver = getattr(config, "resolved_obs_port", None)
+        if resolver is not None:
+            obs_port = resolver()
+        if obs_port is not None and self.telemetry.enabled:
+            from maggy_tpu.telemetry import obs as obs_mod
+            from maggy_tpu.telemetry.profiling import ProfileCapturer
+
+            self.profiler = ProfileCapturer(
+                self.telemetry,
+                profile_dir=self.exp_dir + "/profiles")
+            if self.health is not None:
+                self.health.attach(profiler=self.profiler)
+            self.obs_registration = obs_mod.ObsRegistration(
+                key="{}/{}".format(app_id, run_id),
+                labels={"experiment": self.name,
+                        "run": "{}/{}".format(app_id, run_id)},
+                telemetry=self.telemetry,
+                status_fn=self.obs_status,
+                health=self.health,
+                profiler=self.profiler)
+            server = obs_mod.register(
+                self.obs_registration, port=obs_port,
+                host=getattr(config, "obs_host", "127.0.0.1"))
+            # Discovery record: port 0 binds an ephemeral port, and the
+            # journal is where tools (monitor --live, the soak scraper)
+            # learn the real address.
+            self.telemetry.event(
+                "obs_started", host=server.address[0],
+                port=server.address[1], experiment=self.name,
+                app_id=app_id, run_id=run_id)
         self._register_msg_callbacks()
 
     # ------------------------------------------------------------- template
@@ -265,6 +306,15 @@ class Driver(ABC):
         self.experiment_done = True
         if self._worker_thread is not None:
             self._worker_thread.join(timeout=5)
+        if self.obs_registration is not None:
+            # Deregister BEFORE the telemetry teardown: a scrape landing
+            # mid-stop must not read a closing journal. The process obs
+            # listener itself closes only when the last experiment
+            # leaves.
+            from maggy_tpu.telemetry import obs as obs_mod
+
+            obs_mod.deregister(self.obs_registration)
+            self.obs_registration = None
         if self.health is not None:
             self.health.close()
         self.server.stop()
@@ -291,6 +341,28 @@ class Driver(ABC):
 
     def progress_snapshot(self) -> Dict[str, Any]:
         return {}
+
+    def obs_status(self) -> Dict[str, Any]:
+        """Live control-plane state for the obs /status route: progress
+        plus the reservation table (who holds what). Subclasses extend
+        with their own stores (trial backlog, gangs, fleet shares).
+        Read-only and lock-brief — runs on an obs handler thread, never
+        holding more than one structure's lock at a time."""
+        progress = {k: v for k, v in self.progress_snapshot().items()
+                    if k not in ("log_tail", "log_total")}
+        reservations = {}
+        for pid, rec in self.server.reservations.all().items():
+            reservations[pid] = {
+                "trial": rec.get("trial_id"),
+                "released": bool(rec.get("released")),
+                "evict": bool(rec.get("evict")),
+                "gang": rec.get("gang"),
+                "capacity": rec.get("capacity"),
+            }
+        return {"experiment": self.name, "app_id": self.app_id,
+                "run_id": self.run_id, "driver": type(self).__name__,
+                "done": self.experiment_done, "progress": progress,
+                "reservations": reservations}
 
     def _log(self, msg: str) -> None:
         line = "{} ({}/{}): {}".format(

@@ -457,6 +457,9 @@ class OptimizationDriver(Driver):
     def _blacklist_msg_callback(self, msg) -> None:
         """Executor died and re-registered: requeue its trial (reference
         :363-367 + `rpc.py:308-326`)."""
+        # The pid now names a REPLACEMENT process: the dead one's gauges
+        # and merged stats are stale (the new runner re-ships its own).
+        self.telemetry.prune_partition(msg.get("partition_id"))
         trial = self.get_trial(msg["trial_id"])
         if trial is not None and self.gang_members(trial.trial_id):
             # A re-registered gang leader cannot simply take its trial
@@ -532,6 +535,11 @@ class OptimizationDriver(Driver):
         if pool is not None and pool.kill_worker(msg["partition_id"]):
             self._log("runner {} killed after heartbeat loss (presumed "
                       "wedged)".format(msg["partition_id"]))
+        # The dead runner's live gauges/stats must not outlive it: a
+        # reaped partition's last RSS/cadence would sit in the registry
+        # (and the /metrics exposition, and the health-engine medians)
+        # forever. A respawned runner repopulates on its first beat.
+        self.telemetry.prune_partition(msg.get("partition_id"))
 
     def _chips_for(self, trial: Trial) -> Optional[int]:
         """Chip requirement of a trial under chips_per_budget (None when
@@ -959,6 +967,9 @@ class OptimizationDriver(Driver):
                 # heartbeat draws STOP(preempt); the ack finds the trial
                 # already waiting and is dropped.
                 self.server.reservations.request_stop(leader, tid)
+        # The dead MEMBER's gauges must not linger (the healthy members
+        # keep reporting their own).
+        self.telemetry.prune_partition(pid)
 
     # ------------------------------------------- pipelined hand-off (prefetch)
 
@@ -1716,6 +1727,35 @@ class OptimizationDriver(Driver):
                 duration, r["num_trials"]),
         ]
         return "\n".join(lines)
+
+    def obs_status(self) -> Dict[str, Any]:
+        """Extend the base /status document with the HPO driver's live
+        scheduling state: trial-store/backlog counts, assembled gangs (+
+        placer blocks), and the fleet scheduler's share snapshot when
+        fleet-attached. Locks are taken one at a time, never nested —
+        this runs on an obs handler thread."""
+        out = super().obs_status()
+        with self._store_lock:
+            out["store"] = {
+                "trials": len(self._trial_store),
+                "finalized": len(self._final_store),
+                "requeue": len(self._requeue),
+                "parked": len(self._parked),
+                "gang_wait": len(self._gang_wait),
+            }
+            out["gangs"] = {
+                tid: {"chips": info.get("chips"),
+                      "members": list(info.get("members") or []),
+                      "leader": info.get("leader"),
+                      "strategy": info.get("strategy"),
+                      "revoking": bool(info.get("revoking"))}
+                for tid, info in self._gangs.items()}
+        if self._placer is not None:
+            out["pack"] = self._placer.snapshot()
+        binding = getattr(self.config, "fleet", None)
+        if binding is not None:
+            out["fleet"] = binding.fleet.scheduler.snapshot()
+        return out
 
     def progress_snapshot(self) -> Dict[str, Any]:
         with self._store_lock:

@@ -739,7 +739,9 @@ class Fleet:
     def __init__(self, runners: int = 2, *, pool: str = "thread",
                  name: str = "fleet", home_dir: Optional[str] = None,
                  env=None, max_active: Optional[int] = None,
-                 preempt_grace_s: float = 1.0, telemetry: bool = True):
+                 preempt_grace_s: float = 1.0, telemetry: bool = True,
+                 obs_port: Optional[int] = None,
+                 obs_host: str = "127.0.0.1"):
         if pool != "thread":
             raise ValueError(
                 "fleet pools are in-process ('thread'): experiments are "
@@ -771,6 +773,17 @@ class Fleet:
         self._submissions: Dict[str, FleetSubmission] = {}  # guarded-by: _lock
         self._sub_threads: List[threading.Thread] = []  # guarded-by: _lock
         self._sub_seq = itertools.count()
+        #: Live observability plane for the fleet HOST process: the fleet
+        #: registers its scheduler status with the process obs server, so
+        #: /status shows share allocation and queue depth even between
+        #: experiments (each attached driver additionally registers its
+        #: own experiment). None (+ no MAGGY_TPU_OBS_PORT) = off.
+        from maggy_tpu.config import resolved_env_obs_port
+
+        self._obs_port = obs_port if obs_port is not None \
+            else resolved_env_obs_port()
+        self._obs_host = obs_host
+        self._obs_registration = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -781,6 +794,19 @@ class Fleet:
             self._started = True
         self.telemetry.event("fleet", phase="start", name=self.name,
                              runners=self.num_runners, pool="thread")
+        if self._obs_port is not None and self.telemetry.enabled:
+            from maggy_tpu.telemetry import obs as obs_mod
+
+            self._obs_registration = obs_mod.ObsRegistration(
+                key="fleet:{}".format(self.name),
+                labels={"experiment": self.name, "run": "fleet"},
+                telemetry=self.telemetry, status_fn=self.status)
+            server = obs_mod.register(self._obs_registration,
+                                      port=self._obs_port,
+                                      host=self._obs_host)
+            self.telemetry.event("obs_started", host=server.address[0],
+                                 port=server.address[1],
+                                 experiment=self.name)
         self._pool_thread = threading.Thread(
             target=self.pool.run, args=(self._runner_loop,),
             daemon=True, name="fleet-pool")
@@ -837,6 +863,11 @@ class Fleet:
             if t is not None:
                 t.join(timeout=5)
         self.shared_server.stop()
+        if self._obs_registration is not None:
+            from maggy_tpu.telemetry import obs as obs_mod
+
+            obs_mod.deregister(self._obs_registration)
+            self._obs_registration = None
         self.telemetry.event("fleet", phase="stop")
         self._dump_status()
         self.telemetry.close()
@@ -898,9 +929,17 @@ class Fleet:
         try:
             sub = exp_mod._begin_run(config, self.env, exclusive=False)
             slots = entry.effective_max(self.num_runners)
-            cfg = dataclasses.replace(
-                config, fleet=FleetBinding(self, entry),
-                num_workers=max(1, slots))
+            replacements = dict(fleet=FleetBinding(self, entry),
+                                num_workers=max(1, slots))
+            if self._obs_port is not None \
+                    and getattr(config, "obs_port", None) is None:
+                # The fleet host's obs plane covers its tenants: an
+                # attached experiment registers onto the SAME process
+                # server (one per process), so /status shows every
+                # live experiment next to the fleet's share state. An
+                # experiment's own obs_port still wins when set.
+                replacements["obs_port"] = self._obs_port
+            cfg = dataclasses.replace(config, **replacements)
             driver = exp_mod.lagom_driver(cfg, sub.app_id, sub.run_id)
             import atexit
 

@@ -61,6 +61,32 @@ def poll_telemetry(addr: Tuple[str, int], secret: str,
     return _poll(addr, secret, "TELEM", timeout=timeout)
 
 
+def poll_live(base_url: str,
+              timeout: float = 10.0) -> Tuple[Dict[str, Any], int,
+                                              Dict[str, Any]]:
+    """One scrape of the observability plane (telemetry.obs): ``(status
+    document, healthz HTTP code, healthz body)``. ``base_url`` is
+    ``host:port`` or a full ``http://`` URL — no secret needed, the obs
+    endpoints are plain HTTP (loopback-bound by default)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    if "//" not in base_url:
+        base_url = "http://" + base_url
+    base_url = base_url.rstrip("/")
+    with urllib.request.urlopen(base_url + "/status",
+                                timeout=timeout) as resp:
+        status = _json.loads(resp.read().decode())
+    try:
+        with urllib.request.urlopen(base_url + "/healthz",
+                                    timeout=timeout) as resp:
+            return status, resp.status, _json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        # 503 = unhealthy, still a valid, body-carrying reply.
+        return status, e.code, _json.loads(e.read().decode())
+
+
 def render(snap: Dict[str, Any]) -> str:
     if "num_trials" in snap:  # HPO / ablation experiment
         done = snap.get("finalized", 0)
@@ -210,6 +236,61 @@ def render_health(snap: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_live(status: Dict[str, Any], healthz_code: int,
+                healthz: Dict[str, Any]) -> str:
+    """Multi-line view of one obs /status + /healthz scrape: a header
+    per registered experiment (progress, backlog, reservations, gangs,
+    fleet share) above the familiar telemetry block."""
+    lines = ["healthz: {} ({})".format(
+        healthz_code, healthz.get("status", "?"))]
+    for flags in (e.get("flags") or []
+                  for e in (healthz.get("experiments") or {}).values()):
+        for flag in flags:
+            lines.append(_fmt_flag(flag))
+    experiments = status.get("experiments") or {}
+    if not experiments:
+        lines.append("no experiments registered")
+    for key in sorted(experiments):
+        doc = experiments[key]
+        st = doc.get("status") or {}
+        progress = st.get("progress") or {}
+        lines.append("== {} ({}) ==".format(
+            (doc.get("labels") or {}).get("experiment", key), key))
+        if "num_trials" in progress or "finalized" in progress:
+            lines.append("progress: {}/{} finalized, best={}".format(
+                progress.get("finalized", "?"),
+                progress.get("num_trials", "?"),
+                progress.get("best_val")))
+        store = st.get("store") or {}
+        if store:
+            lines.append(
+                "store: {} trials / {} finalized / {} requeued / {} "
+                "parked / {} gang-waiting".format(
+                    store.get("trials", 0), store.get("finalized", 0),
+                    store.get("requeue", 0), store.get("parked", 0),
+                    store.get("gang_wait", 0)))
+        reservations = st.get("reservations") or {}
+        if reservations:
+            busy = sum(1 for r in reservations.values() if r.get("trial"))
+            lines.append("runners: {} registered, {} busy".format(
+                len(reservations), busy))
+        gangs = st.get("gangs") or {}
+        for tid, g in sorted(gangs.items()):
+            lines.append("gang {}: {} chips, members {}, leader {}{}".format(
+                tid, g.get("chips"), g.get("members"), g.get("leader"),
+                " [revoking]" if g.get("revoking") else ""))
+        fleet = st.get("fleet") or {}
+        if fleet:
+            lines.append("fleet: {} runner(s), {} active, queue depth "
+                         "{}".format(fleet.get("fleet_size"),
+                                     fleet.get("active"),
+                                     fleet.get("queue_depth")))
+        telem = doc.get("telem") or {}
+        if telem.get("enabled"):
+            lines.extend("  " + ln for ln in render_telem(telem).split("\n"))
+    return "\n".join(lines)
+
+
 def render_fleet(status: Dict[str, Any],
                  replay: Dict[str, Any]) -> str:
     """Multi-line view of a fleet: scheduler status (from status.json)
@@ -295,6 +376,12 @@ def main(argv=None) -> int:
                         "health engine plus per-partition runner stats "
                         "(step cadence, time-to-first-metric, heartbeat "
                         "RTT, RSS)")
+    p.add_argument("--live", metavar="HOST:PORT",
+                   help="watch via the observability plane instead of the "
+                        "RPC verbs: scrape GET /status + /healthz from a "
+                        "driver/fleet started with config.obs_port (or "
+                        "MAGGY_TPU_OBS_PORT) — no secret needed; the "
+                        "bound address is journaled as obs_started")
     p.add_argument("--fleet", metavar="HOME",
                    help="watch a shared fleet instead of one experiment: "
                         "renders per-experiment share, queue depth, and "
@@ -305,6 +392,36 @@ def main(argv=None) -> int:
     if (args.telem or args.health) and args.logs:
         p.error("--logs streams over the LOG verb; run it without "
                 "--telem/--health (or use two monitor processes)")
+    if args.live:
+        if args.telem or args.health or args.logs or args.fleet:
+            p.error("--live scrapes the obs HTTP endpoints; drop "
+                    "--telem/--health/--logs/--fleet")
+        polled_ok = False
+        failures = 0
+        last = None
+        while True:
+            try:
+                status, code, healthz = poll_live(args.live)
+            except OSError as e:
+                if not polled_ok:
+                    print("cannot reach obs server at {}: {}".format(
+                        args.live, e), file=sys.stderr)
+                    return 1
+                failures += 1
+                if failures >= 3:
+                    print("experiment finished (obs server gone)")
+                    return 0
+                time.sleep(args.interval)
+                continue
+            failures = 0
+            polled_ok = True
+            line = render_live(status, code, healthz)
+            if line != last:
+                print(line, flush=True)
+                last = line
+            if args.once:
+                return 0
+            time.sleep(args.interval)
     if args.fleet:
         if args.telem or args.health or args.logs:
             p.error("--fleet is file-based; drop --telem/--health/--logs")

@@ -178,6 +178,26 @@ class Telemetry:
                     "compile.warm_hits" if record.get("warm")
                     else "compile.warm_misses").inc()
 
+    def prune_partition(self, partition) -> None:
+        """Forget a dead/replaced partition's live state: its
+        ``runner.<field>.p<pid>`` gauges, merged runner-stats entry, and
+        progress stamp. Called by the driver on the LOST/BLACK/GANG_LOST
+        paths — a reaped runner's last RSS/cadence must not sit in the
+        registry (and the /metrics exposition) forever, nor skew the
+        health engine's fleet medians. The journal keeps the history;
+        this only clears the LIVE view. A re-registered partition
+        repopulates on its next heartbeat."""
+        if not self.enabled or partition is None:
+            return
+        pid = int(partition)
+        suffix = ".p{}".format(pid)
+        self.metrics.prune(
+            lambda name: name.startswith("runner.")
+            and name.endswith(suffix))
+        with self._runner_lock:
+            self._runner_state.pop(pid, None)
+            self._progress.pop(pid, None)
+
     def _note_progress(self, pid: int) -> None:
         with self._runner_lock:
             self._progress[pid] = time.monotonic()

@@ -124,6 +124,12 @@ class HealthEngine:
         self.startup_factor = float(startup_factor)
         self.dump_threads_on_hang = dump_threads_on_hang
         self.reservations = None
+        #: Optional telemetry.profiling.ProfileCapturer: the FIRST
+        #: straggler/hang raise per partition triggers a device-profile
+        #: capture (rate-limited there), so a flagged anomaly yields an
+        #: inspectable artifact, not just a journal line. Attached by
+        #: the driver when the observability plane is on.
+        self.profiler = None
         self._lock = threading.Lock()
         #: (check, metric, partition) -> active flag dict.
         self._active: Dict[tuple, Dict[str, Any]] = {}  # guarded-by: _lock
@@ -134,11 +140,14 @@ class HealthEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def attach(self, reservations=None) -> None:
+    def attach(self, reservations=None, profiler=None) -> None:
         """Late-bind the authoritative partition->trial assignment view
-        (the server's Reservations) for the hang watchdog."""
+        (the server's Reservations) for the hang watchdog, and/or the
+        profile capturer for health-triggered captures."""
         if reservations is not None:
             self.reservations = reservations
+        if profiler is not None:
+            self.profiler = profiler
 
     # ------------------------------------------------------------ lifecycle
 
@@ -211,6 +220,15 @@ class HealthEngine:
             if f["check"] == "hang" and self.dump_threads_on_hang:
                 fields["stacks"] = thread_dump()
             self.telemetry.event("health", status="raised", **fields)
+            profiler = self.profiler
+            if profiler is not None:
+                # First straggler/hang raise per partition -> capture a
+                # device profile at the moment of the anomaly (rate
+                # limiting lives in the capturer; runs on its own
+                # thread, so the check cadence is unaffected).
+                profiler.auto_capture(check=f["check"],
+                                      partition=f.get("partition"),
+                                      trial=f.get("trial"))
         for f in cleared:
             self.telemetry.event(
                 "health", status="cleared", check=f["check"],

@@ -180,6 +180,22 @@ class MetricsRegistry:
                 metric = self._histograms[name] = Histogram(bounds)
             return metric
 
+    def prune(self, predicate) -> int:
+        """Drop every metric whose NAME satisfies ``predicate``. The
+        registry grows one gauge per live runner field per partition
+        (``runner.<field>.p<pid>``); without pruning, a reaped or
+        replaced partition's gauges linger forever — skewing snapshots
+        and polluting the /metrics exposition with dead series. Returns
+        the number of metrics removed. Callers must not hold metric
+        refs across a prune (get-or-create re-mints them)."""
+        removed = 0
+        with self._lock:
+            for table in (self._counters, self._gauges, self._histograms):
+                for name in [n for n in table if predicate(n)]:
+                    del table[name]
+                    removed += 1
+        return removed
+
     def snapshot(self) -> Dict[str, Dict[str, object]]:
         """Plain-dict snapshot of every metric (json/msgpack-serializable)."""
         with self._lock:

@@ -1,0 +1,189 @@
+"""On-demand and health-triggered device profiling.
+
+The TPUv4 scaling experience (PAPERS.md) is that step-time regressions
+are only diagnosable from a device profile captured AT the anomaly — a
+post-hoc journal line says a partition straggled, not why. This module
+owns that capture:
+
+- ``ProfileCapturer.capture``: one bounded ``jax.profiler`` trace window
+  written under ``<exp_dir>/profiles/<stamp>/`` together with a
+  faulthandler all-threads dump (``threads.txt``). The dump lands FIRST
+  and the ``profile_captured`` journal event is recorded as soon as the
+  artifact directory is real — a hard kill mid-trace still leaves a
+  linked, inspectable artifact. ``jax.profiler`` being unavailable (or
+  already tracing for ``config.profile``) degrades to the dump alone,
+  recorded as ``profiler: "unavailable"``.
+- ``ProfileCapturer.auto_capture``: the HealthEngine's hook — the FIRST
+  ``straggler``/``hang`` raise per partition triggers a background
+  capture, rate-limited to one per partition and ``AUTO_CAPTURE_LIMIT``
+  per run so a flapping fleet cannot profile itself to death. Runs on
+  its own daemon thread: the health check cadence never blocks on a
+  trace window.
+
+Captures journal a ``profile_captured`` event (path, reason, check,
+partition, duration) so ``monitor`` and the Perfetto export can link the
+artifact to the moment of the anomaly.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+#: Auto (health-triggered) captures per run, across all partitions.
+AUTO_CAPTURE_LIMIT = 2
+
+#: Trace window for auto captures, seconds. Short on purpose: the stalled
+#: partition is stalled NOW, and the capture must land in the journal
+#: before the experiment can wind down.
+AUTO_CAPTURE_DURATION_S = 0.5
+
+#: Health checks that trigger an auto capture (mirrors the stall checks
+#: the chaos harness asserts on).
+AUTO_CAPTURE_CHECKS = ("straggler", "hang")
+
+
+class ProfileCapturer:
+    """Capture coordinator for one experiment. Thread-safe; at most one
+    capture in flight at a time (the device profiler is a global)."""
+
+    def __init__(self, telemetry, profile_dir: str,
+                 auto_limit: int = AUTO_CAPTURE_LIMIT,
+                 auto_duration_s: float = AUTO_CAPTURE_DURATION_S):
+        self.telemetry = telemetry
+        self.profile_dir = profile_dir
+        self.auto_limit = int(auto_limit)
+        self.auto_duration_s = float(auto_duration_s)
+        self._lock = threading.Lock()
+        self._busy = False  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        #: Partitions whose first straggler/hang raise already captured.
+        self._auto_partitions: set = set()  # guarded-by: _lock
+        self._auto_count = 0  # guarded-by: _lock
+
+    # -------------------------------------------------------------- capture
+
+    def capture(self, duration_s: float = 2.0, reason: str = "manual",
+                check: Optional[str] = None, partition=None,
+                trial: Optional[str] = None) -> Dict[str, Any]:
+        """Run one capture window synchronously on the CALLER's thread
+        (obs /profilez handlers run per-request threads; auto captures
+        come through ``auto_capture``'s worker). Returns the journaled
+        record, or ``{"skipped": ...}`` when a capture is already in
+        flight."""
+        with self._lock:
+            if self._busy:
+                return {"skipped": "capture already in flight"}
+            self._busy = True
+            self._seq += 1
+            seq = self._seq
+        try:
+            stamp = "{}_{:03d}_{}".format(int(time.time()), seq, reason)
+            if partition is not None:
+                stamp += "_p{}".format(partition)
+            target = os.path.join(self.profile_dir, stamp)
+            os.makedirs(target, exist_ok=True)
+            # The thread dump is the cheap, always-available half of the
+            # artifact — written before the trace so even a failed or
+            # interrupted profiler leaves evidence.
+            from maggy_tpu.telemetry.health import thread_dump
+
+            try:
+                with open(os.path.join(target, "threads.txt"), "w") as f:
+                    f.write(thread_dump(max_bytes=1 << 20))
+            except OSError:
+                pass
+            record: Dict[str, Any] = {
+                "path": target, "reason": reason,
+                "duration_s": round(float(duration_s), 3)}
+            if check is not None:
+                record["check"] = check
+            if partition is not None:
+                record["partition"] = partition
+            if trial is not None:
+                record["trial"] = trial
+            # Journal BEFORE the trace attempt: the artifact directory
+            # (with the dump) is already real, and jax.profiler's FIRST
+            # start_trace can take ~10 s of one-time init — a journal
+            # write deferred past it can miss a winding-down experiment
+            # entirely (and a crash inside the trace window must not
+            # orphan the artifact either way).
+            self.telemetry.event("profile_captured", **record)
+            started = self._start_trace(target)
+            record["profiler"] = "jax" if started is True \
+                else "unavailable"
+            if started is not True:
+                record["profiler_error"] = started
+            if started is True:
+                time.sleep(float(duration_s))
+                self._stop_trace()
+            return record
+        finally:
+            with self._lock:
+                self._busy = False
+
+    @staticmethod
+    def _start_trace(target: str):
+        """True on success, else the error repr (jax absent, profiler
+        already active for config.profile, unsupported backend...)."""
+        try:
+            import jax
+
+            jax.profiler.start_trace(target)
+            return True
+        except Exception as e:  # noqa: BLE001 - capture must degrade, never raise
+            return repr(e)
+
+    @staticmethod
+    def _stop_trace() -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            pass
+
+    # --------------------------------------------------------- auto capture
+
+    def auto_capture(self, check: str, partition,
+                     trial: Optional[str] = None) -> bool:
+        """Health-engine hook: capture on the first straggler/hang raise
+        per partition (max ``auto_limit`` per run). Returns whether a
+        capture was started; the capture itself runs on a daemon thread
+        so the health-check loop keeps its cadence."""
+        if check not in AUTO_CAPTURE_CHECKS or partition is None:
+            return False
+        with self._lock:
+            if partition in self._auto_partitions \
+                    or self._auto_count >= self.auto_limit:
+                return False
+            self._auto_partitions.add(partition)
+            self._auto_count += 1
+        threading.Thread(
+            target=self._auto_worker, args=(check, partition, trial),
+            daemon=True, name="telemetry-profile").start()
+        return True
+
+    def _auto_worker(self, check: str, partition, trial) -> None:
+        """Capture for one health-flagged partition, WAITING OUT a busy
+        capturer instead of losing the slot: correlated stalls flag two
+        partitions in one health pass, and the first capture can hold
+        ``_busy`` for ~10 s (profiler init) — a skip here would burn the
+        second partition's once-per-run slot with no artifact. If the
+        capturer is still busy after the wait window, the slot is rolled
+        back so a later re-raise can try again."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            record = self.capture(duration_s=self.auto_duration_s,
+                                  reason="auto", check=check,
+                                  partition=partition, trial=trial)
+            if not record.get("skipped"):
+                return
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.25)
+        with self._lock:
+            self._auto_partitions.discard(partition)
+            self._auto_count -= 1

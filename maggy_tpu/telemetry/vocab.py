@@ -55,6 +55,12 @@ EVENT_KINDS = frozenset({
     "preempt",                # fleet preemption decision
     "pack",                   # gang placer decision (op: init/reserve/
                               #   stall/release — maggy_tpu.gang)
+    "obs_started",            # observability server bound (host, port) —
+                              #   journaled so tools can discover an
+                              #   ephemeral (port 0) bind
+    "profile_captured",       # device profile + thread dump artifact
+                              #   written (path, reason: manual|auto,
+                              #   check, partition — telemetry.profiling)
 })
 
 #: ``reason=`` on a trial ``requeued`` phase: why it re-entered the
@@ -67,6 +73,11 @@ REQUEUE_REASONS = frozenset({
     "gang_member_lost",  # a gang member died: whole lease revoked, the
                          # trial reassembles a fresh gang (exactly once)
 })
+
+#: ``reason=`` on a ``profile_captured`` event: what triggered the
+#: capture — an operator /profilez request or the health engine's
+#: first-flag auto-capture hook (telemetry/profiling.py).
+PROFILE_REASONS = frozenset({"manual", "auto"})
 
 #: ``phase=`` per non-trial event kind.
 EXPERIMENT_PHASES = frozenset({"start", "resumed", "finalized", "end"})
@@ -98,10 +109,10 @@ HEALTH_CHECKS = frozenset({"engine", "straggler", "hb_rtt", "hang"})
 ALL_PHASES = (frozenset(SPAN_PHASES) | EXPERIMENT_PHASES | RUNNER_PHASES
               | WORKER_PHASES | FLEET_PHASES | FLEET_EXPERIMENT_PHASES
               | LEASE_PHASES)
-ALL_REASONS = REQUEUE_REASONS | LEASE_END_REASONS
+ALL_REASONS = REQUEUE_REASONS | LEASE_END_REASONS | PROFILE_REASONS
 
 __all__ = [
-    "SPAN_PHASES", "EVENT_KINDS", "REQUEUE_REASONS",
+    "SPAN_PHASES", "EVENT_KINDS", "REQUEUE_REASONS", "PROFILE_REASONS",
     "EXPERIMENT_PHASES", "RUNNER_PHASES", "WORKER_PHASES",
     "FLEET_PHASES", "FLEET_EXPERIMENT_PHASES", "LEASE_PHASES",
     "LEASE_END_REASONS", "CHAOS_KINDS", "HEALTH_STATUSES",

@@ -54,6 +54,12 @@ def pytest_configure(config):
         "package-must-analyze-clean gate. Select with -m analysis.")
     config.addinivalue_line(
         "markers",
+        "obs: live observability plane tests (maggy_tpu.telemetry.obs) — "
+        "the /metrics-/status-/healthz-/profilez HTTP surface, the "
+        "Prometheus rendering, health-triggered profile capture, and the "
+        "tier-1 scrape-vs-journal smoke. Select with -m obs.")
+    config.addinivalue_line(
+        "markers",
         "fleet: shared-fleet scheduler tests (maggy_tpu.fleet) — "
         "multiplexing concurrent experiments over one runner fleet with "
         "fair share, priorities, and checkpoint-assisted preemption. "

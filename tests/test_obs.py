@@ -1,0 +1,844 @@
+"""Live observability plane (maggy_tpu.telemetry.obs + profiling):
+Prometheus rendering, the four HTTP routes over a real server, process
+lifecycle (off by default, last-deregistration closes the socket),
+health-triggered profile capture with its rate limit, dead-runner gauge
+pruning, the TELEM snapshot schema pin, monitor --live, and the tier-1
+smoke that scrapes a live sweep mid-run and checks the scrape against
+the journal replay."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from maggy_tpu import monitor
+from maggy_tpu.core.environment import EnvSing
+from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+from maggy_tpu.telemetry import MetricsRegistry, Telemetry
+from maggy_tpu.telemetry import obs
+from maggy_tpu.telemetry.profiling import (AUTO_CAPTURE_LIMIT,
+                                           ProfileCapturer)
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def local_env(tmp_path):
+    env = LocalEnv(base_dir=str(tmp_path / "exp"))
+    EnvSing.set_instance(env)
+    yield env
+    EnvSing.reset()
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_obs_server():
+    """Every test must leave the process obs singleton closed — a leaked
+    listener would couple unrelated tests through one socket."""
+    yield
+    server = obs.active_server()
+    if server is not None:  # pragma: no cover - only on test bugs
+        for reg in server.registrations():
+            obs.deregister(reg)
+    assert obs.active_server() is None
+
+
+def _get(base, route, timeout=5):
+    return urllib.request.urlopen(base + route, timeout=timeout)
+
+
+def _get_json(base, route):
+    with _get(base, route) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+# ------------------------------------------------------------- prometheus
+
+
+class TestPrometheusRender:
+    def test_counter_gauge_histogram_families(self):
+        reg = MetricsRegistry()
+        reg.counter("trial.phase.finalized").inc(3)
+        reg.counter("compile.warm_hits").inc()
+        reg.gauge("runner.rss_mb.p2").set(812.5)
+        reg.histogram("rpc.handle_ms.FINAL", bounds=(1.0, 10.0)).observe(2.0)
+        text = obs.render_prometheus(
+            [({"experiment": "e1", "run": "a/0"}, reg.snapshot())])
+        assert ('maggy_tpu_trial_phase_total{experiment="e1",'
+                'phase="finalized",run="a/0"} 3') in text
+        assert ('maggy_tpu_compile_warm_hits_total{experiment="e1",'
+                'run="a/0"} 1') in text
+        # Per-partition gauges become ONE family with a partition label.
+        assert ('maggy_tpu_runner_rss_mb{experiment="e1",partition="2",'
+                'run="a/0"} 812.5') in text
+        # Histogram buckets are CUMULATIVE and close with +Inf/_sum/_count.
+        assert ('maggy_tpu_rpc_handle_ms_bucket{experiment="e1",'
+                'le="1.0",run="a/0",verb="FINAL"} 0') in text
+        assert ('maggy_tpu_rpc_handle_ms_bucket{experiment="e1",'
+                'le="10.0",run="a/0",verb="FINAL"} 1') in text
+        assert 'le="+Inf"' in text
+        assert ('maggy_tpu_rpc_handle_ms_count{experiment="e1",'
+                'run="a/0",verb="FINAL"} 1') in text
+
+    def test_name_sanitization_and_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("weird-name.with spaces").inc()
+        text = obs.render_prometheus(
+            [({"experiment": 'q"uo\\te'}, reg.snapshot())])
+        assert "maggy_tpu_weird_name_with_spaces_total" in text
+        assert 'experiment="q\\"uo\\\\te"' in text
+
+    def test_none_gauges_skipped(self):
+        reg = MetricsRegistry()
+        reg.gauge("unset")  # created, never written
+        text = obs.render_prometheus([({}, reg.snapshot())])
+        assert "unset" not in text
+
+    def test_multi_experiment_samples_share_families(self):
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        r1.counter("trial.phase.finalized").inc(1)
+        r2.counter("trial.phase.finalized").inc(2)
+        text = obs.render_prometheus(
+            [({"experiment": "a"}, r1.snapshot()),
+             ({"experiment": "b"}, r2.snapshot())])
+        assert text.count("# TYPE maggy_tpu_trial_phase_total counter") == 1
+        assert 'experiment="a"' in text and 'experiment="b"' in text
+
+
+# ------------------------------------------------------------- obs server
+
+
+class TestObsServer:
+    def test_routes_and_lifecycle(self):
+        telem = Telemetry(enabled=True)
+        telem.metrics.counter("trial.phase.queued").inc(2)
+        reg = obs.ObsRegistration(
+            "app/0", {"experiment": "e", "run": "app/0"}, telem,
+            status_fn=lambda: {"store": {"trials": 2}})
+        server = obs.register(reg, port=0)
+        assert obs.active_server() is server
+        base = "http://{}:{}".format(*server.address)
+        with _get(base, "/metrics") as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert "maggy_tpu_trial_phase_total" in body
+        code, doc = _get_json(base, "/status")
+        assert code == 200
+        exp = doc["experiments"]["app/0"]
+        assert exp["telem"]["enabled"] is True
+        assert exp["status"]["store"]["trials"] == 2
+        code, doc = _get_json(base, "/healthz")
+        assert code == 200 and doc["status"] == "ok"
+        obs.deregister(reg)
+        assert obs.active_server() is None
+        with pytest.raises(OSError):
+            _get(base, "/healthz", timeout=1)
+
+    def test_unknown_route_404(self):
+        telem = Telemetry(enabled=True)
+        reg = obs.ObsRegistration("k", {}, telem)
+        server = obs.register(reg, port=0)
+        base = "http://{}:{}".format(*server.address)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(base, "/bogus")
+            assert err.value.code == 404
+            assert "/profilez" in err.value.read().decode()
+        finally:
+            obs.deregister(reg)
+
+    def test_healthz_idle_and_unhealthy(self):
+        telem = Telemetry(enabled=True)
+
+        class FakeHealth:
+            flags = []
+
+            def snapshot(self):
+                return {"flags": list(self.flags), "raised_total":
+                        len(self.flags)}
+
+        health = FakeHealth()
+        reg = obs.ObsRegistration("k", {}, telem, health=health)
+        server = obs.register(reg, port=0)
+        base = "http://{}:{}".format(*server.address)
+        try:
+            code, doc = _get_json(base, "/healthz")
+            assert code == 200 and doc["status"] == "ok"
+            health.flags = [{"check": "hang", "partition": 1}]
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(base, "/healthz")
+            assert err.value.code == 503
+            body = json.loads(err.value.read().decode())
+            assert body["status"] == "unhealthy"
+            assert body["experiments"]["k"]["flags"][0]["check"] == "hang"
+        finally:
+            obs.deregister(reg)
+
+    def test_one_server_per_process_and_refcounted_close(self):
+        t1, t2 = Telemetry(enabled=True), Telemetry(enabled=True)
+        r1 = obs.ObsRegistration("a/0", {"experiment": "a"}, t1)
+        r2 = obs.ObsRegistration("b/0", {"experiment": "b"}, t2)
+        s1 = obs.register(r1, port=0)
+        # A second experiment asking for a DIFFERENT port joins the
+        # existing listener: one obs server per process.
+        s2 = obs.register(r2, port=0)
+        assert s1 is s2
+        base = "http://{}:{}".format(*s1.address)
+        _, doc = _get_json(base, "/status")
+        assert set(doc["experiments"]) == {"a/0", "b/0"}
+        obs.deregister(r1)
+        assert obs.active_server() is s1  # b still registered
+        _, doc = _get_json(base, "/status")
+        assert set(doc["experiments"]) == {"b/0"}
+        obs.deregister(r2)
+        assert obs.active_server() is None
+
+    def test_status_degrades_per_experiment(self):
+        telem = Telemetry(enabled=True)
+
+        def broken():
+            raise RuntimeError("boom")
+
+        reg = obs.ObsRegistration("k", {}, telem, status_fn=broken)
+        server = obs.register(reg, port=0)
+        base = "http://{}:{}".format(*server.address)
+        try:
+            code, doc = _get_json(base, "/status")
+            assert code == 200
+            assert "boom" in doc["experiments"]["k"]["status"]["error"]
+        finally:
+            obs.deregister(reg)
+
+    def test_profilez_routes_to_capturer(self, tmp_path, monkeypatch):
+        telem = Telemetry(enabled=True)
+        prof = ProfileCapturer(telem, str(tmp_path / "profiles"))
+        monkeypatch.setattr(ProfileCapturer, "_start_trace",
+                            staticmethod(lambda target: "stubbed-out"))
+        reg = obs.ObsRegistration("k", {}, telem, profiler=prof)
+        server = obs.register(reg, port=0)
+        base = "http://{}:{}".format(*server.address)
+        try:
+            code, doc = _get_json(base, "/profilez?duration_s=0.1")
+            assert code == 200
+            assert doc["reason"] == "manual"
+            assert os.path.isdir(doc["path"])
+            assert os.path.exists(os.path.join(doc["path"], "threads.txt"))
+            assert [e for e in telem.events()
+                    if e.get("ev") == "profile_captured"]
+        finally:
+            obs.deregister(reg)
+
+    def test_profilez_without_profiler_404(self):
+        telem = Telemetry(enabled=True)
+        reg = obs.ObsRegistration("k", {}, telem)
+        server = obs.register(reg, port=0)
+        base = "http://{}:{}".format(*server.address)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(base, "/profilez")
+            assert err.value.code == 404
+        finally:
+            obs.deregister(reg)
+
+
+# ------------------------------------------------------- profile capturer
+
+
+class TestProfileCapturer:
+    @pytest.fixture(autouse=True)
+    def _stub_trace(self, monkeypatch):
+        """jax.profiler's first start_trace costs ~10 s of one-time init;
+        the capture CONTRACT (artifact + journal + rate limit) is what
+        these tests pin."""
+        monkeypatch.setattr(ProfileCapturer, "_start_trace",
+                            staticmethod(lambda target: "stubbed-out"))
+
+    def test_capture_writes_dump_and_journals(self, tmp_path):
+        telem = Telemetry(enabled=True)
+        prof = ProfileCapturer(telem, str(tmp_path / "p"))
+        rec = prof.capture(duration_s=0.05, reason="manual")
+        assert os.path.exists(os.path.join(rec["path"], "threads.txt"))
+        assert rec["profiler"] == "unavailable"
+        evs = [e for e in telem.events()
+               if e.get("ev") == "profile_captured"]
+        assert len(evs) == 1
+        assert evs[0]["path"] == rec["path"]
+        assert evs[0]["reason"] == "manual"
+
+    def test_auto_capture_once_per_partition(self, tmp_path):
+        telem = Telemetry(enabled=True)
+        prof = ProfileCapturer(telem, str(tmp_path / "p"))
+        assert prof.auto_capture("hang", partition=3) is True
+        # Same partition re-raising (or a straggler flag following the
+        # hang) must NOT capture again.
+        assert prof.auto_capture("straggler", partition=3) is False
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            evs = [e for e in telem.events()
+                   if e.get("ev") == "profile_captured"]
+            if evs:
+                break
+            time.sleep(0.01)
+        assert len(evs) == 1
+        assert evs[0]["partition"] == 3 and evs[0]["reason"] == "auto"
+        assert evs[0]["check"] == "hang"
+
+    def test_auto_capture_run_limit(self, tmp_path):
+        telem = Telemetry(enabled=True)
+        prof = ProfileCapturer(telem, str(tmp_path / "p"))
+        started = [prof.auto_capture("hang", partition=pid)
+                   for pid in range(AUTO_CAPTURE_LIMIT + 3)]
+        assert sum(started) == AUTO_CAPTURE_LIMIT
+
+    def test_auto_capture_ignores_non_stall_checks(self, tmp_path):
+        telem = Telemetry(enabled=True)
+        prof = ProfileCapturer(telem, str(tmp_path / "p"))
+        assert prof.auto_capture("hb_rtt", partition=0) is False
+        assert prof.auto_capture("hang", partition=None) is False
+
+    def test_busy_capture_skips(self, tmp_path):
+        telem = Telemetry(enabled=True)
+        prof = ProfileCapturer(telem, str(tmp_path / "p"))
+        with prof._lock:
+            prof._busy = True
+        assert prof.capture(duration_s=0.01)["skipped"]
+
+    def test_auto_capture_waits_out_a_busy_capturer(self, tmp_path):
+        """Correlated stalls flag two partitions in one health pass; the
+        second auto capture must WAIT for the busy capturer (profiler
+        init can hold it for seconds), not burn its once-per-run slot on
+        a skip."""
+        telem = Telemetry(enabled=True)
+        prof = ProfileCapturer(telem, str(tmp_path / "p"))
+        with prof._lock:
+            prof._busy = True  # partition 0's capture is "in flight"
+        assert prof.auto_capture("hang", partition=1) is True
+
+        def release():
+            time.sleep(0.3)
+            with prof._lock:
+                prof._busy = False
+
+        threading.Thread(target=release, daemon=True).start()
+        deadline = time.monotonic() + 10
+        evs = []
+        while time.monotonic() < deadline and not evs:
+            evs = [e for e in telem.events()
+                   if e.get("ev") == "profile_captured"]
+            time.sleep(0.02)
+        assert len(evs) == 1 and evs[0]["partition"] == 1
+
+    def test_health_engine_triggers_capture(self, tmp_path):
+        from maggy_tpu.telemetry.health import HealthEngine
+
+        telem = Telemetry(enabled=True)
+        engine = HealthEngine(telem, hb_interval=0.01, hang_factor=1.0,
+                              dump_threads_on_hang=False)
+        prof = ProfileCapturer(telem, str(tmp_path / "p"))
+        engine.attach(profiler=prof)
+
+        class Res:
+            def all(self):
+                return {0: {"trial_id": "t1"}}
+
+        engine.attach(reservations=Res())
+        telem._note_progress(0)
+        time.sleep(0.15)
+        flags = engine.check()
+        assert any(f["check"] == "hang" for f in flags)
+        deadline = time.monotonic() + 5
+        evs = []
+        while time.monotonic() < deadline and not evs:
+            evs = [e for e in telem.events()
+                   if e.get("ev") == "profile_captured"]
+            time.sleep(0.01)
+        assert len(evs) == 1 and evs[0]["partition"] == 0
+
+
+# --------------------------------------------------- dead-runner pruning
+
+
+class TestGaugePruning:
+    def test_registry_prune_by_predicate(self):
+        reg = MetricsRegistry()
+        reg.gauge("runner.rss_mb.p0").set(1.0)
+        reg.gauge("runner.rss_mb.p1").set(2.0)
+        reg.counter("keep").inc()
+        removed = reg.prune(lambda n: n.endswith(".p0"))
+        assert removed == 1
+        snap = reg.snapshot()
+        assert "runner.rss_mb.p0" not in snap["gauges"]
+        assert snap["gauges"]["runner.rss_mb.p1"] == 2.0
+        assert snap["counters"]["keep"] == 1
+
+    def test_prune_partition_clears_gauges_state_and_progress(self):
+        telem = Telemetry(enabled=True)
+        telem.record_runner_stats(0, {"rss_mb": 10.0, "hb_rtt_ms": 1.0,
+                                      "steps": 5})
+        telem.record_runner_stats(1, {"rss_mb": 20.0})
+        assert telem.last_progress(0) is not None
+        telem.prune_partition(0)
+        snap = telem.snapshot(fresh=True)
+        gauges = snap["metrics"]["gauges"]
+        assert not any(name.endswith(".p0") for name in gauges)
+        assert "runner.rss_mb.p1" in gauges
+        assert 0 not in snap["runners"] and 1 in snap["runners"]
+        assert telem.last_progress(0) is None
+        # A respawned runner repopulates cleanly.
+        telem.record_runner_stats(0, {"rss_mb": 5.0})
+        assert telem.snapshot(fresh=True)["runners"][0]["rss_mb"] == 5.0
+
+    def test_lost_runner_prunes_registry(self):
+        """Regression (PR 10 satellite): a heartbeat-lost partition's
+        runner.* gauges used to linger in the registry forever."""
+        from maggy_tpu import OptimizationConfig
+        from maggy_tpu.core.driver.optimization_driver import \
+            OptimizationDriver
+        from maggy_tpu.searchspace import Searchspace
+        from maggy_tpu.trial import Trial
+
+        config = OptimizationConfig(
+            name="prune_e2e", num_trials=1, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 1.0])),
+            direction="max", num_workers=1, seed=2, es_policy="none")
+        drv = OptimizationDriver(config, "app", 0)
+        try:
+            trial = Trial({"lr": 0.1})
+            with drv._store_lock:
+                drv._trial_store[trial.trial_id] = trial
+            drv.telemetry.record_runner_stats(
+                0, {"rss_mb": 99.0, "cadence_ms": 50.0})
+            assert "runner.rss_mb.p0" in \
+                drv.telemetry.metrics.snapshot()["gauges"]
+            drv._lost_msg_callback({"trial_id": trial.trial_id,
+                                    "partition_id": 0})
+            gauges = drv.telemetry.metrics.snapshot()["gauges"]
+            assert not any(n.endswith(".p0") for n in gauges)
+            assert drv.telemetry.runner_state() == {}
+        finally:
+            drv.stop()
+
+
+# ------------------------------------------------- TELEM snapshot schema
+
+
+class TestTelemSnapshotSchema:
+    """Satellite: /status embeds the TELEM snapshot verbatim — pin its
+    shape so the wire surface cannot drift silently."""
+
+    def test_top_level_keys_and_types(self):
+        telem = Telemetry(enabled=True)
+        telem.trial_event("t1", "queued")
+        telem.record_runner_stats(0, {"rss_mb": 1.0})
+        snap = telem.snapshot(fresh=True)
+        assert set(snap) == {"enabled", "metrics", "spans", "num_spans",
+                             "runners", "journal"}
+        assert snap["enabled"] is True
+        assert isinstance(snap["num_spans"], int)
+        assert set(snap["metrics"]) == {"counters", "gauges", "histograms"}
+        assert isinstance(snap["runners"], dict)
+        assert set(snap["journal"]) == {"torn_lines"}
+        # json-serializable end to end (the TELEM verb and /status both
+        # ship it verbatim).
+        json.dumps(snap)
+
+    def test_spans_block_schema(self):
+        telem = Telemetry(enabled=True)
+        telem.trial_event("t1", "queued")
+        spans = telem.snapshot(fresh=True)["spans"]
+        # The derive() contract incl. the PR-5 preempt block; dist blocks
+        # are {} or {median_ms, p95_ms, n}.
+        assert set(spans) == {"trials", "handoff", "early_stop_reaction",
+                              "requeue_recovery", "suggest", "preempt",
+                              "compile"}
+        assert set(spans["trials"]) == {"created", "finalized",
+                                        "early_stopped", "errors", "lost",
+                                        "requeued"}
+        for key in ("handoff", "early_stop_reaction", "requeue_recovery"):
+            assert spans[key] == {} or \
+                set(spans[key]) == {"median_ms", "p95_ms", "n"}
+
+    def test_health_block_appears_with_engine(self):
+        from maggy_tpu.telemetry.health import HealthEngine
+
+        telem = Telemetry(enabled=True)
+        telem.health = HealthEngine(telem)
+        snap = telem.snapshot(fresh=True)
+        assert set(snap["health"]) == {"flags", "raised_total",
+                                       "checks_run", "last_check_t"}
+
+    def test_disabled_snapshot(self):
+        assert Telemetry(enabled=False).snapshot() == {"enabled": False}
+
+    def test_status_doc_embeds_snapshot_with_gang_fleet_blocks(self):
+        """The /status document's driver half: the gang and fleet-share
+        state (PRs 8/5) ride under status.gangs / status.fleet."""
+        telem = Telemetry(enabled=True)
+        status = {"store": {"trials": 1},
+                  "gangs": {"tid": {"chips": 4, "members": [0, 1, 2, 3],
+                                    "leader": 0, "strategy": "fsdp",
+                                    "revoking": False}},
+                  "fleet": {"fleet_size": 2, "queue_depth": 0,
+                            "active": 1, "experiments": []}}
+        reg = obs.ObsRegistration("k", {}, telem, status_fn=lambda: status)
+        server = obs.register(reg, port=0)
+        try:
+            doc = server.status_doc()
+            exp = doc["experiments"]["k"]
+            assert exp["telem"]["enabled"] is True
+            assert exp["status"]["gangs"]["tid"]["chips"] == 4
+            assert exp["status"]["fleet"]["fleet_size"] == 2
+            json.dumps(doc)
+        finally:
+            obs.deregister(reg)
+
+
+# ----------------------------------------------------------- monitor --live
+
+
+class TestMonitorLive:
+    def test_poll_and_render_live(self):
+        telem = Telemetry(enabled=True)
+        telem.trial_event("t1", "queued")
+        status = {"progress": {"num_trials": 3, "finalized": 1,
+                               "best_val": 0.9},
+                  "store": {"trials": 2, "finalized": 1, "requeue": 0,
+                            "parked": 0, "gang_wait": 0},
+                  "reservations": {"0": {"trial": "t1"}}}
+        reg = obs.ObsRegistration(
+            "app/0", {"experiment": "live_e", "run": "app/0"}, telem,
+            status_fn=lambda: status)
+        server = obs.register(reg, port=0)
+        try:
+            doc, code, healthz = monitor.poll_live(
+                "{}:{}".format(*server.address))
+            assert code == 200
+            text = monitor.render_live(doc, code, healthz)
+            assert "healthz: 200 (ok)" in text
+            assert "live_e" in text
+            assert "progress: 1/3 finalized" in text
+            assert "store: 2 trials / 1 finalized" in text
+        finally:
+            obs.deregister(reg)
+
+    def test_render_live_unhealthy_and_empty(self):
+        text = monitor.render_live({"experiments": {}}, 503,
+                                   {"status": "unhealthy",
+                                    "experiments": {"k": {"flags": [
+                                        {"check": "hang", "partition": 2,
+                                         "trial": "t", "silent_s": 1.0,
+                                         "bound_s": 0.5}]}}})
+        assert "healthz: 503 (unhealthy)" in text
+        assert "[hang] partition 2" in text
+        assert "no experiments registered" in text
+
+
+# ------------------------------------------------------------ driver e2e
+
+
+def _obs_train(lr, reporter=None):
+    acc = 1.0 - abs(lr - 0.1)
+    for step in range(3):
+        reporter.broadcast(acc * (step + 1) / 3.0, step=step)
+        time.sleep(0.02)
+    return {"metric": acc}
+
+
+@pytest.mark.timeout(120)
+class TestDriverIntegration:
+    def test_obs_off_by_default_no_socket(self, local_env):
+        from maggy_tpu import OptimizationConfig, Searchspace, experiment
+
+        config = OptimizationConfig(
+            name="obs_off", num_trials=1, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2])),
+            direction="max", num_workers=1, hb_interval=0.02, seed=3,
+            es_policy="none")
+        seen = {"server": False}
+        stop = threading.Event()
+
+        def watch():
+            while not stop.is_set():
+                if obs.active_server() is not None:
+                    seen["server"] = True
+                time.sleep(0.005)
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        try:
+            result = experiment.lagom(_obs_train, config)
+        finally:
+            stop.set()
+            t.join()
+        assert result["num_trials"] == 1
+        assert seen["server"] is False, \
+            "obs_port unset must open no socket"
+        from maggy_tpu.telemetry import JOURNAL_NAME, read_events
+
+        exp_dir = os.path.join(local_env.base_dir,
+                               os.listdir(local_env.base_dir)[0])
+        events = read_events(os.path.join(exp_dir, JOURNAL_NAME))
+        assert [e for e in events if e.get("ev") == "obs_started"] == []
+
+    def test_smoke_scrape_agrees_with_journal(self, local_env):
+        """Tier-1 obs smoke (ISSUE 10 acceptance): a 3-trial sweep with
+        obs on, /metrics + /status + /healthz scraped MID-RUN, and the
+        scrape checked against the journal-replayed values at the end —
+        every scraped finalized count must sit between the journal
+        counts bracketing the scrape's wall time."""
+        from maggy_tpu import OptimizationConfig, Searchspace, experiment
+        from maggy_tpu.telemetry import JOURNAL_NAME, read_events
+
+        samples = []  # (wall_t, /metrics finalized, /status finalized)
+        healthz_codes = []
+        failures = []
+        stop = threading.Event()
+
+        def scraper():
+            base = None
+            while not stop.is_set():
+                server = obs.active_server()
+                if server is None:
+                    if base is not None:
+                        return
+                    time.sleep(0.005)
+                    continue
+                if base is None:
+                    base = "http://{}:{}".format(*server.address)
+                try:
+                    metrics = _get(base, "/metrics").read().decode()
+                    _, status = _get_json(base, "/status")
+                    try:
+                        with _get(base, "/healthz") as resp:
+                            healthz_codes.append(resp.status)
+                    except urllib.error.HTTPError as e:
+                        # 503 is a VALID healthz verdict (a transient
+                        # hang flag under CPU-loaded CI is truthful,
+                        # not a scrape failure).
+                        healthz_codes.append(e.code)
+                    wall = time.time()
+                    count = 0
+                    for line in metrics.splitlines():
+                        if line.startswith("maggy_tpu_trial_phase_total") \
+                                and 'phase="finalized"' in line:
+                            count = int(float(line.rsplit(" ", 1)[1]))
+                    exp = next(iter(status["experiments"].values()))
+                    samples.append(
+                        (wall, count,
+                         exp["status"]["store"]["finalized"]))
+                except Exception as e:  # noqa: BLE001
+                    if obs.active_server() is not None:
+                        failures.append(repr(e))
+                time.sleep(0.01)
+
+        thread = threading.Thread(target=scraper, daemon=True)
+        thread.start()
+        config = OptimizationConfig(
+            name="obs_smoke", num_trials=3, optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2])),
+            direction="max", num_workers=1, hb_interval=0.02, seed=3,
+            es_policy="none", obs_port=0,
+            # A loaded CI host can deschedule the lone runner past the
+            # default hang bound; this smoke is about scrape-vs-journal
+            # agreement, not hang detection (the chaos obs soak covers
+            # that), so keep the watchdog quiet.
+            health_hang_factor=500.0)
+        result = experiment.lagom(_obs_train, config)
+        stop.set()
+        thread.join(timeout=10)
+        assert result["num_trials"] == 3
+        assert failures == [], "obs endpoints failed mid-sweep"
+        assert samples, "no successful mid-run scrape"
+        assert set(healthz_codes) <= {200, 503} and healthz_codes
+        exp_dir = os.path.join(local_env.base_dir,
+                               os.listdir(local_env.base_dir)[0])
+        events = read_events(os.path.join(exp_dir, JOURNAL_NAME))
+        started = [e for e in events if e.get("ev") == "obs_started"]
+        assert len(started) == 1 and started[0]["port"] > 0
+        fin_times = sorted(e["t"] for e in events
+                           if e.get("ev") == "trial"
+                           and e.get("phase") == "finalized")
+        assert len(fin_times) == 3
+        slack = 0.5
+        for wall, metric_count, status_count in samples:
+            lo = sum(1 for t in fin_times if t <= wall - slack)
+            hi = sum(1 for t in fin_times if t <= wall + slack)
+            assert lo <= metric_count <= hi, \
+                "scraped /metrics finalized={} outside journal bounds " \
+                "[{}, {}] at t={}".format(metric_count, lo, hi, wall)
+            assert lo <= status_count <= hi
+        # Counters are monotone across scrapes (no lost increments).
+        counts = [c for _, c, _ in samples]
+        assert counts == sorted(counts)
+
+
+# ------------------------------------------------------------ fleet mode
+
+
+@pytest.mark.fleet
+@pytest.mark.timeout(120)
+class TestFleetObs:
+    def test_fleet_host_serves_all_tenants(self, local_env):
+        """One obs server per PROCESS: a fleet started with obs on
+        registers its own share/queue status, every submitted experiment
+        registers onto the SAME listener while attached (without any
+        obs config of its own), and deregisters on completion."""
+        from maggy_tpu import OptimizationConfig, Searchspace
+        from maggy_tpu.fleet import Fleet
+
+        fleet = Fleet(runners=2, name="obsfleet", obs_port=0).start()
+        try:
+            server = obs.active_server()
+            assert server is not None
+            base = "http://{}:{}".format(*server.address)
+            seen = set()
+            stop = threading.Event()
+
+            def watch():
+                while not stop.is_set():
+                    try:
+                        _, doc = _get_json(base, "/status")
+                        seen.update(doc["experiments"])
+                    except Exception:  # noqa: BLE001
+                        pass
+                    time.sleep(0.02)
+
+            thread = threading.Thread(target=watch, daemon=True)
+            thread.start()
+
+            def cfg(name):
+                return OptimizationConfig(
+                    name=name, num_trials=3, optimizer="randomsearch",
+                    searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2])),
+                    direction="max", num_workers=2, hb_interval=0.02,
+                    seed=3, es_policy="none")
+
+            h1 = fleet.submit(_obs_train_fleet, cfg("exp_a"))
+            h2 = fleet.submit(_obs_train_fleet, cfg("exp_b"))
+            h1.result(60)
+            h2.result(60)
+            stop.set()
+            thread.join(timeout=5)
+            assert "fleet:obsfleet" in seen
+            assert len(seen) >= 3, \
+                "tenant experiments never registered: {}".format(seen)
+            _, doc = _get_json(base, "/status")
+            assert sorted(doc["experiments"]) == ["fleet:obsfleet"], \
+                "tenants must deregister on completion"
+        finally:
+            fleet.shutdown()
+        assert obs.active_server() is None
+
+
+def _obs_train_fleet(lr, reporter=None):
+    acc = 1.0 - abs(lr - 0.1)
+    for step in range(3):
+        reporter.broadcast(acc * (step + 1) / 3.0, step=step)
+        time.sleep(0.02)
+    return {"metric": acc}
+
+
+# ----------------------------------------------------- chaos invariant 9
+
+
+@pytest.mark.chaos
+@pytest.mark.timeout(180)
+class TestChaosObsSoak:
+    def test_stall_soak_endpoints_responsive_and_one_profile(
+            self, tmp_path):
+        """ISSUE 10 acceptance: a chaos ``stall_runner`` soak with the
+        obs plane on leaves the endpoints responsive (zero scrape
+        failures), /healthz reports the stall truthfully (503 while
+        flagged), and the stalled partition journals exactly ONE
+        ``profile_captured`` artifact — all asserted by the harness's
+        invariant 9 plus re-checked here against the journal."""
+        from maggy_tpu.chaos import harness
+        from maggy_tpu.telemetry import read_events
+
+        report = harness.run_soak(
+            plan=harness.stall_plan(seed=7), seed=7,
+            hb_loss_timeout=10.0, base_dir=str(tmp_path / "soak"),
+            config_overrides={"health_hang_factor": 10.0,
+                              "health_interval_s": 0.1},
+            lock_witness=False, obs=True)
+        assert report["ok"], report["violations"]
+        assert report["obs"]["scrapes"] > 0
+        assert report["obs"]["failures"] == []
+        assert report["obs"]["unhealthy_seen"] > 0, \
+            "/healthz never reported the stall"
+        assert report["profiles"]["obs_armed"] is True
+        events = read_events(report["journal"])
+        stalled = {e["partition"] for e in events
+                   if e.get("ev") == "chaos"
+                   and e.get("kind") == "stall_runner"}
+        captures = [e for e in events
+                    if e.get("ev") == "profile_captured"
+                    and e.get("reason") == "auto"]
+        assert len(stalled) == 1
+        per_stalled = [c for c in captures
+                       if c.get("partition") in stalled]
+        assert len(per_stalled) == 1, captures
+        assert os.path.isdir(per_stalled[0]["path"])
+        assert os.path.exists(
+            os.path.join(per_stalled[0]["path"], "threads.txt"))
+
+    def test_check_invariants_flags_missing_and_duplicate_captures(self):
+        """Invariant 9's journal half, unit-level: obs armed + flagged
+        stall with no capture = violation; two captures for one stalled
+        partition = violation; exactly one = clean."""
+        from maggy_tpu.chaos.harness import check_invariants
+
+        def journal(n_captures):
+            evs = [
+                {"t": 1.0, "ev": "obs_started", "port": 1234},
+                {"t": 1.0, "ev": "health", "check": "engine",
+                 "status": "started"},
+                {"t": 2.0, "ev": "trial", "trial": "a", "phase": "queued"},
+                {"t": 5.0, "ev": "trial", "trial": "a",
+                 "phase": "finalized"},
+                {"t": 3.0, "ev": "chaos", "kind": "stall_runner",
+                 "partition": 0, "trial": "a"},
+                {"t": 3.5, "ev": "health", "status": "raised",
+                 "check": "hang", "partition": 0},
+                {"t": 9.0, "ev": "experiment", "phase": "end"},
+            ]
+            for i in range(n_captures):
+                evs.append({"t": 3.6 + i, "ev": "profile_captured",
+                            "reason": "auto", "partition": 0,
+                            "path": "/tmp/x{}".format(i)})
+            return evs
+
+        clean = check_invariants(journal(1), stall_flag_bound_s=5.0)
+        assert clean["ok"], clean["violations"]
+        assert clean["profiles"] == {"obs_armed": True, "captured": 1,
+                                     "auto": 1}
+        missing = check_invariants(journal(0), stall_flag_bound_s=5.0)
+        assert any("missing profile capture" in v
+                   for v in missing["violations"])
+        dup = check_invariants(journal(2), stall_flag_bound_s=5.0)
+        assert any("duplicate profile capture" in v
+                   for v in dup["violations"])
+
+    def test_check_invariants_skips_without_obs(self):
+        """A pre-obs (or obs-off) journal must not fail the capture
+        invariant — nothing was armed to capture."""
+        from maggy_tpu.chaos.harness import check_invariants
+
+        evs = [
+            {"t": 1.0, "ev": "health", "check": "engine",
+             "status": "started"},
+            {"t": 2.0, "ev": "trial", "trial": "a", "phase": "queued"},
+            {"t": 5.0, "ev": "trial", "trial": "a", "phase": "finalized"},
+            {"t": 3.0, "ev": "chaos", "kind": "stall_runner",
+             "partition": 0, "trial": "a"},
+            {"t": 3.5, "ev": "health", "status": "raised",
+             "check": "hang", "partition": 0},
+            {"t": 9.0, "ev": "experiment", "phase": "end"},
+        ]
+        report = check_invariants(evs, stall_flag_bound_s=5.0)
+        assert report["ok"], report["violations"]
+        assert report["profiles"]["obs_armed"] is False

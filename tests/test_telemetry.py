@@ -348,6 +348,154 @@ def _train(lr, units, reporter=None):
 
 
 @pytest.mark.timeout(120)
+class TestJournalRotation:
+    """Satellite (PR 10): size-based rotation — MAGGY_TPU_JOURNAL_MAX_MB
+    (or max_mb) seals the active file into numbered segments; replay and
+    resume transparently read the segments in order."""
+
+    def _ev(self, i):
+        return {"t": float(i), "ev": "trial", "trial": "t{}".format(i),
+                "phase": "queued", "pad": "x" * 64}
+
+    def test_rotation_seals_segments_and_replay_is_continuous(
+            self, tmp_path, local_env):
+        path = str(tmp_path / "exp" / "telemetry.jsonl")
+        # ~100-byte events, 1 KB cap -> several segments over 100 events.
+        journal = TelemetryJournal(local_env, path, flush_interval_s=3600,
+                                   max_mb=1024 / (1024 * 1024.0))
+        for i in range(100):
+            journal.record(self._ev(i))
+            if i % 10 == 9:
+                journal.flush()
+        journal.close()
+        segments = sorted(f for f in os.listdir(str(tmp_path / "exp"))
+                          if f.startswith("telemetry.jsonl."))
+        assert len(segments) >= 2, "cap never rotated"
+        # The active file stays small; the stream reads back complete
+        # and IN ORDER across segments + active.
+        assert os.path.getsize(path) < 4096
+        events = read_events(path)
+        assert [e["trial"] for e in events] == \
+            ["t{}".format(i) for i in range(100)]
+        assert events.torn_lines == 0
+
+    def test_rotation_off_by_default(self, tmp_path, local_env,
+                                     monkeypatch):
+        monkeypatch.delenv("MAGGY_TPU_JOURNAL_MAX_MB", raising=False)
+        path = str(tmp_path / "exp" / "telemetry.jsonl")
+        journal = TelemetryJournal(local_env, path, flush_interval_s=3600)
+        for i in range(50):
+            journal.record(self._ev(i))
+            journal.flush()
+        journal.close()
+        assert [f for f in os.listdir(str(tmp_path / "exp"))
+                if f.startswith("telemetry.jsonl.")] == []
+        assert len(read_events(path)) == 50
+
+    def test_env_var_arms_rotation(self, tmp_path, local_env, monkeypatch):
+        monkeypatch.setenv("MAGGY_TPU_JOURNAL_MAX_MB",
+                           str(1024 / (1024 * 1024.0)))
+        path = str(tmp_path / "exp" / "telemetry.jsonl")
+        journal = TelemetryJournal(local_env, path, flush_interval_s=3600)
+        for i in range(60):
+            journal.record(self._ev(i))
+            if i % 10 == 9:
+                journal.flush()
+        journal.close()
+        assert [f for f in os.listdir(str(tmp_path / "exp"))
+                if f.startswith("telemetry.jsonl.")]
+        assert len(read_events(path)) == 60
+
+    def test_replay_journal_identical_to_unrotated(self, tmp_path,
+                                                   local_env):
+        """Same events, rotated vs not: replay_journal must produce the
+        same numbers — rotation is a storage detail, not a semantic."""
+        rotated = str(tmp_path / "exp" / "rot.jsonl")
+        plain = str(tmp_path / "exp" / "plain.jsonl")
+        events = []
+        for i in range(40):
+            events.append({"t": 10.0 + i, "ev": "trial",
+                           "trial": "t{}".format(i % 8),
+                           "phase": "queued" if i < 8 else "finalized",
+                           "partition": i % 2, "pad": "y" * 80})
+        j1 = TelemetryJournal(local_env, rotated, flush_interval_s=3600,
+                              max_mb=1024 / (1024 * 1024.0))
+        j2 = TelemetryJournal(local_env, plain, flush_interval_s=3600)
+        for e in events:
+            j1.record(dict(e))
+            j2.record(dict(e))
+            j1.flush()
+        j2.flush()
+        j1.close()
+        j2.close()
+        assert [f for f in os.listdir(str(tmp_path / "exp"))
+                if f.startswith("rot.jsonl.")]
+        assert replay_journal(rotated) == replay_journal(plain)
+
+    def test_resume_restores_across_segments_and_keeps_appending(
+            self, tmp_path, local_env):
+        path = str(tmp_path / "exp" / "telemetry.jsonl")
+        cap = 1024 / (1024 * 1024.0)
+        first = TelemetryJournal(local_env, path, flush_interval_s=3600,
+                                 max_mb=cap)
+        for i in range(50):
+            first.record(self._ev(i))
+            if i % 10 == 9:
+                first.flush()
+        first.flush()
+        # Simulated crash: no close(); a second driver resumes.
+        second = TelemetryJournal(local_env, path, flush_interval_s=3600,
+                                  max_mb=cap)
+        assert second.load_existing() == 50
+        for i in range(50, 70):
+            second.record(self._ev(i))
+            if i % 10 == 9:
+                second.flush()
+        second.close()
+        events = read_events(path)
+        assert [e["trial"] for e in events] == \
+            ["t{}".format(i) for i in range(70)]
+        # The resumed writer must NOT have resurrected the sealed
+        # segments into the active file (no duplicates anywhere).
+        assert len({e["trial"] for e in events}) == 70
+
+    def test_rotation_with_rewrite_only_backend(self, tmp_path):
+        """Object-store-shaped env (no append): the rewrite path must
+        rewrite only the ACTIVE file's share, so rotation still bounds
+        per-flush work and replay stays exact."""
+
+        class NoAppendEnv(LocalEnv):
+            def open_file(self, p, mode="r"):
+                if mode == "a":
+                    raise OSError("append not supported")
+                return super().open_file(p, mode)
+
+        env = NoAppendEnv(base_dir=str(tmp_path / "exp"))
+        path = str(tmp_path / "exp" / "telemetry.jsonl")
+        journal = TelemetryJournal(env, path, flush_interval_s=3600,
+                                   max_mb=1024 / (1024 * 1024.0))
+        for i in range(60):
+            journal.record(self._ev(i))
+            if i % 10 == 9:
+                journal.flush()
+        journal.close()
+        assert [f for f in os.listdir(str(tmp_path / "exp"))
+                if f.startswith("telemetry.jsonl.")]
+        assert [e["trial"] for e in read_events(path)] == \
+            ["t{}".format(i) for i in range(60)]
+
+    def test_torn_lines_summed_across_segments(self, tmp_path, local_env):
+        path = str(tmp_path / "exp" / "telemetry.jsonl")
+        local_env.dump('{"t": 1.0, "ev": "trial", "trial": "a", '
+                       '"phase": "queued"}\nGARBAGE\n',
+                       path + ".000001")
+        local_env.dump('{"t": 2.0, "ev": "trial", "trial": "b", '
+                       '"phase": "queued"}\n{"t": 3.0, "ev"', path)
+        events = read_events(path)
+        assert [e["trial"] for e in events] == ["a", "b"]
+        assert events.torn_lines == 2
+
+
 class TestDriverRoundTrip:
     def _run(self, local_env, **overrides):
         from maggy_tpu import OptimizationConfig, Searchspace, experiment
