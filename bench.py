@@ -1014,6 +1014,54 @@ def obs_main():
     return 0 if ok else 1
 
 
+def scale_main():
+    """``bench.py --scale``: service-scale control-plane soak (see
+    maggy_tpu/fleet/soak.py run_scale_soak). Three phases against real
+    fleets: (1) a >=500-concurrent-experiment churn through one fleet
+    (lagom_submit + the spool path) gating tenant completion, scheduler
+    decision throughput, and admission latency p99; (2) three weighted
+    resident tenants gating journal-replayed fair-share error; (3) the
+    slow-tenant A/B — per-tenant dispatch pools ON must hold the victim
+    hand-off p95 isolation bound, and the pool-OFF (pre-fix shared-loop)
+    arm must show the head-of-line inflation the pools remove. Always a
+    CPU-pinned run (the plane under test is platform-independent Python;
+    detail.platform records the pin per the ROADMAP comparability note).
+    Exit 1 on any gate violation."""
+    if "MAGGY_TPU_BASE_DIR" not in os.environ:
+        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    for var in _ACCEL_BOOTSTRAP_VARS:
+        os.environ.pop(var, None)
+    from maggy_tpu.fleet.soak import run_scale_soak
+
+    seed = int(os.environ.get("BENCH_SCALE_SEED", "7"))
+    experiments = int(os.environ.get("BENCH_SCALE_EXPERIMENTS", "520"))
+    runners = int(os.environ.get("BENCH_SCALE_RUNNERS", "8"))
+    max_active = int(os.environ.get("BENCH_SCALE_MAX_ACTIVE", "12"))
+    t0 = time.time()
+    report = run_scale_soak(experiments=experiments, runners=runners,
+                            max_active=max_active, seed=seed)
+    churn = report["detail"]["churn"]
+    print(json.dumps({
+        "metric": "scale soak ({} tenants / {} runners churn + weighted "
+                  "share + slow-tenant A/B, journal-checked)".format(
+                      experiments, runners),
+        "value": churn.get("experiments_per_s") or 0.0,
+        "unit": "experiments_per_s",
+        "detail": {
+            "seed": seed,
+            "wall_s": round(time.time() - t0, 1),
+            "violations": report["violations"],
+            "scale": report["detail"],
+            "platform": "cpu pinned (forced; the control plane under "
+                        "test is platform-independent — pinned for "
+                        "cross-round comparability)",
+            "journal": report["journal"],
+        },
+    }), flush=True)
+    return 0 if report["ok"] else 1
+
+
 def extra_main(name):
     """Child process: run ONE extra bench and print its JSON on stdout."""
     if name == "hang":  # test hook: simulates a compile stall / wedged op
@@ -1458,4 +1506,6 @@ if __name__ == "__main__":
         sys.exit(pack_main())
     if "--obs" in sys.argv:
         sys.exit(obs_main())
+    if "--scale" in sys.argv:
+        sys.exit(scale_main())
     sys.exit(main())

@@ -46,6 +46,21 @@ CLIENT_MAX_RETRIES = 3
 CLIENT_RETRY_BACKOFF_BASE_S = 0.05
 CLIENT_RETRY_BACKOFF_CAP_S = 2.0
 RPC_RECV_BUFSIZE = 1 << 16
+# Heartbeat batching: beats whose ship failed are kept client-side
+# (coalesced per trial, rstats stripped — the rstats delta requeues into
+# the runner-stats buffer separately) and shipped together as ONE BATCH
+# frame on the next beat. The bounds cap memory on a long driver outage
+# — beat COUNT and coalesced LOG LINES per banked beat; beyond them the
+# oldest entries are dropped, which matches the pre-batching behavior
+# (a failed beat's payload was simply lost).
+CLIENT_MAX_PENDING_BEATS = 16
+CLIENT_MAX_PENDING_LOG_LINES = 500
+# Shared-fleet control plane (rpc.SharedServer): bounded per-tenant
+# dispatch queue depth. A tenant whose handlers fall behind fills its own
+# queue; further frames for THAT tenant are dropped with the connection
+# (the client's retry/backoff path re-delivers), which is the per-tenant
+# backpressure signal — other tenants' queues are unaffected.
+TENANT_DISPATCH_QUEUE_DEPTH = 512
 
 # Failure detection: a runner whose assigned trial has gone this many
 # heartbeat intervals without any message is declared lost and its trial is

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import queue
 import secrets as pysecrets
 import selectors
 import socket
@@ -528,7 +529,12 @@ class Server:
             return
         buf = self._buffers[conn]
         buf.extend(chunk)
-        while True:
+        # Stop at the first drop: a dispatch may sever the connection
+        # (chaos sever, send failure) while MORE complete frames sit in
+        # the local buffer — processing them would reply into a closed
+        # socket and, in the shared-server subclass, resurrect the
+        # connection's routing entry (the sever-mid-frame leak).
+        while conn in self._buffers:
             frame = self._try_extract_frame(conn, buf)
             if frame is None:
                 return
@@ -618,6 +624,28 @@ class Server:
                     "rpc.handle_ms.{}".format(msg.get("type")),
                     (time.monotonic() - t0) * 1e3)
 
+    def _batch(self, msg):
+        """Coalesced heartbeat batch: a client whose beats failed to ship
+        (driver stall, reconnect storm) re-delivers them as ONE frame —
+        ``beats`` is an oldest-first list of METRIC payloads, coalesced
+        client-side per trial. Each beat runs through the ordinary METRIC
+        handler (so liveness touches, rstats merges, and driver metric
+        history all land), and the reply is the NEWEST beat's reply — a
+        STOP/preempt decision about a retired beat's trial is stale by
+        definition, and heartbeats re-draw STOP until honored anyway."""
+        metric = self._handlers.get("METRIC")
+        if metric is None:
+            return {"type": "ERR",
+                    "error": "this server does not accept heartbeats"}
+        reply: Dict[str, Any] = {"type": "OK"}
+        for beat in msg.get("beats") or []:
+            b = dict(beat)
+            b["type"] = "METRIC"
+            b["partition_id"] = msg["partition_id"]
+            b["task_attempt"] = msg.get("task_attempt")
+            reply = metric(b)
+        return reply
+
     def _drop(self, conn):
         self._buffers.pop(conn, None)
         try:
@@ -684,6 +712,53 @@ class Server:
         self._sel.close()
 
 
+class _TenantDispatcher:
+    """Bounded per-tenant handler pool: one daemon worker draining one
+    FIFO queue of (conn, payload) frames for ONE attached experiment.
+    A single worker per tenant keeps the ordering guarantee a dedicated
+    listener gave — frames from one connection are handled and replied
+    in arrival order — while isolating the tenant's handler latency from
+    every other tenant. ``submit`` never blocks: a full queue returns
+    False and the caller sheds the frame (per-tenant backpressure)."""
+
+    def __init__(self, shared: "SharedServer", server: "Server",
+                 depth: int):
+        self.depth = int(depth)
+        self._shared = shared
+        self._server = server
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="rpc-dispatch-{}".format(server.secret_hex[:8]))
+        self._thread.start()
+
+    def submit(self, conn, payload: bytes) -> bool:
+        try:
+            self._q.put_nowait((conn, payload))
+            return True
+        except queue.Full:
+            return False
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, payload = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._shared._dispatch(conn, self._server, payload)
+            except Exception:  # noqa: BLE001 - one bad frame must not kill the tenant's pool
+                pass
+
+
 class SharedServer:
     """One listening socket multiplexing MANY experiments' control
     planes (fleet mode): each attached per-experiment ``Server`` keeps
@@ -694,24 +769,49 @@ class SharedServer:
     no new sockets on the driver host: the runner reconnects to the SAME
     address with the NEW experiment's secret.
 
+    Dispatch architecture: the event loop does PURE frame work — accept,
+    reassemble, authenticate/route — and hands each complete frame to the
+    target experiment's ``_TenantDispatcher``, a bounded FIFO queue
+    drained by one dedicated worker thread per attached server. Handlers
+    (and their replies) run on that worker, so one tenant's slow handler
+    (a FINAL fast path waiting out its bounded sched-lock timeout, a
+    chaos ``delay_msg``, a degraded controller) stalls ONLY its own
+    tenant's queue; every other experiment's replies keep flowing at
+    loop speed. Ordering: one worker per tenant + in-order enqueue from
+    the loop = per-connection FIFO handling and replies, exactly the
+    guarantee a dedicated listener gave. Backpressure: a tenant whose
+    queue is full has its overflowing frame AND connection shed (counted
+    as ``rpc.tenant.backpressure_drops`` on the tenant's registry and
+    journaled as a ``shed`` event with ``scope="rpc"``); the client's
+    jittered retry/backoff path re-delivers, so a congested tenant slows
+    itself down without consuming loop time. ``dispatch_pool=False`` (or
+    MAGGY_TPU_SHARED_DISPATCH_POOL=0) restores the legacy
+    handlers-on-the-loop behavior for A/B measurement — bench.py --scale
+    uses exactly that switch to show the head-of-line isolation.
+
     The shared event loop also drives each attached server's ``_tick``
     (heartbeat-loss scans) and the chaos engine's elapsed-time triggers,
-    exactly as a dedicated loop would.
+    exactly as a dedicated loop would."""
 
-    Known trade-off: handlers run ON the shared loop, so one
-    experiment's slow handler (a FINAL fast path waiting out its bounded
-    sched-lock timeout, a chaos delay_msg) briefly head-of-line-blocks
-    the other experiments' replies — coupling a dedicated listener would
-    not have. The bound is PREFETCH_FINAL_LOCK_TIMEOUT_S (every handler
-    is otherwise buffer-only); moving dispatch onto a per-experiment
-    handler pool is the escape hatch if fleet-scale telemetry shows the
-    coupling in the hand-off gaps."""
+    def __init__(self, dispatch_pool: Optional[bool] = None,
+                 tenant_queue_depth: Optional[int] = None):
+        import os
 
-    def __init__(self):
+        if dispatch_pool is None:
+            dispatch_pool = os.environ.get(
+                "MAGGY_TPU_SHARED_DISPATCH_POOL", "1").strip().lower() \
+                not in ("0", "false", "off")
+        self.dispatch_pool = bool(dispatch_pool)
+        self.tenant_queue_depth = int(
+            tenant_queue_depth
+            if tenant_queue_depth is not None
+            else os.environ.get("MAGGY_TPU_TENANT_QUEUE_DEPTH",
+                                constants.TENANT_DISPATCH_QUEUE_DEPTH))
         self._lock = threading.RLock()
         self._servers: Dict[bytes, Server] = {}  # guarded-by: _lock
-        self._conn_server: Dict[socket.socket, Server] = {}
-        self._buffers: Dict[socket.socket, bytearray] = {}
+        self._dispatchers: Dict[bytes, _TenantDispatcher] = {}  # guarded-by: _lock
+        self._conn_server: Dict[socket.socket, Server] = {}  # guarded-by: _lock
+        self._buffers: Dict[socket.socket, bytearray] = {}  # guarded-by: _lock
         self._sel = selectors.DefaultSelector()
         self._listener: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
@@ -724,6 +824,9 @@ class SharedServer:
         returns the shared (host, port)."""
         with self._lock:
             self._servers[server.secret] = server
+            if self.dispatch_pool:
+                self._dispatchers[server.secret] = _TenantDispatcher(
+                    self, server, self.tenant_queue_depth)
             server._shared = self
             if self._listener is None:
                 self._start_locked(host)
@@ -732,9 +835,12 @@ class SharedServer:
     def detach(self, server: Server) -> None:
         with self._lock:
             self._servers.pop(server.secret, None)
+            dispatcher = self._dispatchers.pop(server.secret, None)
             stale = [c for c, s in self._conn_server.items() if s is server]
         for conn in stale:
             self._drop(conn)
+        if dispatcher is not None:
+            dispatcher.stop()
 
     def _start_locked(self, host: str, port: int = 0) -> None:
         from maggy_tpu import native
@@ -755,7 +861,8 @@ class SharedServer:
     def _accept(self, sock, mask):
         conn, _ = sock.accept()
         conn.setblocking(False)
-        self._buffers[conn] = bytearray()
+        with self._lock:
+            self._buffers[conn] = bytearray()
         self._sel.register(conn, selectors.EVENT_READ, self._serve)
 
     def _serve(self, conn, mask):
@@ -769,16 +876,26 @@ class SharedServer:
         if not chunk:
             self._drop(conn)
             return
-        buf = self._buffers.get(conn)
+        with self._lock:
+            buf = self._buffers.get(conn)
         if buf is None:
             return
         buf.extend(chunk)
-        while True:
+        # Stop at the first drop: routing (shed), a pool-less dispatch,
+        # or a bad frame may sever the connection while MORE complete
+        # frames sit in the local buffer — continuing would dispatch
+        # frames of a closed socket and re-bind it into _conn_server
+        # (the sever-mid-frame bookkeeping leak).
+        while self._tracked(conn):
             extracted = self._try_extract_frame(conn, buf)
             if extracted is None:
                 return
             server, payload = extracted
-            self._dispatch(conn, server, payload)
+            self._route(conn, server, payload)
+
+    def _tracked(self, conn) -> bool:
+        with self._lock:
+            return conn in self._buffers
 
     def _try_extract_frame(self, conn, buf: bytearray):
         """Pop one complete frame and resolve which experiment it belongs
@@ -808,14 +925,43 @@ class SharedServer:
             return None
         if bound is None:
             with self._lock:
+                # Bind only while the connection is still tracked: a
+                # concurrent drop (pool-thread send failure) must not be
+                # resurrected as a routing entry for a closed socket.
+                if conn not in self._buffers:
+                    return None
                 self._conn_server[conn] = server
         del buf[:header + length]
         return server, payload
 
+    def _route(self, conn, server: Server, payload: bytes) -> None:
+        """Hand one authenticated frame to the tenant's dispatch pool —
+        the event loop's ONLY job besides framing. Pool off (legacy /
+        A/B) dispatches inline on the loop."""
+        with self._lock:
+            dispatcher = self._dispatchers.get(server.secret)
+        if dispatcher is None:
+            self._dispatch(conn, server, payload)
+            return
+        if not dispatcher.submit(conn, payload):
+            # Per-tenant backpressure: THIS tenant's queue is full —
+            # shed the frame and the connection (the client's jittered
+            # retry re-delivers), leaving other tenants untouched.
+            telem = server.telemetry
+            if telem is not None:
+                telem.metrics.counter(
+                    "rpc.tenant.backpressure_drops").inc()
+                telem.event("shed", scope="rpc",
+                            queue_depth=dispatcher.depth)
+            self._drop(conn)
+
     def _dispatch(self, conn, server: Server, payload: bytes):
         """Mirror of ``Server._dispatch`` with the target server resolved
         per frame: same chaos hooks, same error wrapping, reply signed
-        with THAT experiment's secret."""
+        with THAT experiment's secret. Runs on the tenant's dispatcher
+        worker (pool mode), so a chaos ``delay_msg`` stalls only the
+        targeted tenant — the fault's blast radius matches the new
+        architecture's isolation claim."""
         sever_reply = False
         try:
             msg = msgpack.unpackb(payload, raw=False, strict_map_key=False)
@@ -851,8 +997,12 @@ class SharedServer:
                 pass
 
     def _drop(self, conn):
-        self._buffers.pop(conn, None)
+        """Thread-safe teardown of one connection's state — called from
+        the event loop AND the tenant dispatcher workers (reply/send
+        failures), so every table it touches is lock-guarded and every
+        step tolerates a concurrent double-drop."""
         with self._lock:
+            self._buffers.pop(conn, None)
             self._conn_server.pop(conn, None)
         try:
             self._sel.unregister(conn)
@@ -886,6 +1036,10 @@ class SharedServer:
         with self._lock:
             servers = list(self._servers.values())
             self._servers.clear()
+            dispatchers = list(self._dispatchers.values())
+            self._dispatchers.clear()
+        for dispatcher in dispatchers:
+            dispatcher.stop()
         for server in servers:
             server._shared = None
         for key in list(self._sel.get_map().values()):
@@ -913,6 +1067,7 @@ class OptimizationServer(Server):
         self._handlers.update(
             REG=self._reg,
             METRIC=self._metric,
+            BATCH=self._batch,
             FINAL=self._final,
             GET=self._get,
             LOG=self._log,
@@ -1158,6 +1313,7 @@ class DistributedServer(Server):
         self._handlers.update(
             REG=self._reg,
             METRIC=self._metric,
+            BATCH=self._batch,
             FINAL=self._final,
             DIST_CONFIG=self._dist_config,
             LOG=self._log,
@@ -1362,8 +1518,37 @@ class Client:
             time.sleep(constants.CLIENT_POLL_INTERVAL_S)
         raise TimeoutError("Registration barrier not reached.")
 
+    @staticmethod
+    def _queue_beat(pending: list, payload: Dict[str, Any]) -> None:
+        """Bank a failed beat for BATCH re-delivery: coalesce with the
+        newest pending beat when both describe the SAME trial (keep the
+        fresher metric/step/span, concatenate logs — the driver only
+        wants the latest sample plus every log line), and bound the
+        backlog to CLIENT_MAX_PENDING_BEATS, dropping oldest-first (the
+        pre-batching behavior for ALL failed beats). The caller strips
+        ``rstats`` first: that delta requeues through the runner-stats
+        buffer's own ledger and must not ship twice."""
+        beat = {k: v for k, v in payload.items() if k != "rstats"}
+        if pending and pending[-1].get("trial_id") == beat.get("trial_id"):
+            merged = dict(beat)
+            # Bounded, newest-last: an unbounded concatenation would let
+            # a chatty trial grow one banked beat past MAX_FRAME over a
+            # long outage — the beat-count bound alone caps nothing.
+            merged["logs"] = ((pending[-1].get("logs") or [])
+                              + (beat.get("logs") or []))[
+                -constants.CLIENT_MAX_PENDING_LOG_LINES:]
+            pending[-1] = merged
+            return
+        pending.append(beat)
+        del pending[:-constants.CLIENT_MAX_PENDING_BEATS]
+
     def start_heartbeat(self, reporter) -> None:
         def beat():
+            # Beats whose ship failed, oldest first — re-delivered as ONE
+            # BATCH frame on the next successful beat instead of being
+            # silently lost (and instead of a reconnect storm replaying
+            # them one frame at a time against a recovering driver).
+            pending: list = []
             while not self._hb_stop.is_set():
                 try:
                     data = reporter.get_data()
@@ -1390,10 +1575,20 @@ class Client:
                     delta = stats.snapshot_delta()
                     if delta:
                         payload["rstats"] = delta
+                if pending:
+                    # The current beat rides LAST so the server's reply
+                    # (STOP decisions included) is about the newest data.
+                    send = {"type": "BATCH", "beats": pending + [payload]}
+                else:
+                    send = payload
                 t_send = time.monotonic()
                 try:
-                    resp = self._request(payload, sock=self._hb_sock,
+                    resp = self._request(send, sock=self._hb_sock,
                                          lock=False)
+                    if pending:
+                        CLIENT_METRICS.counter(
+                            "rpc.client.batched_beats").inc(len(pending))
+                        pending = []
                     if stats is not None:
                         # Retries/backoff included ON PURPOSE: this is the
                         # control-plane latency the runner experiences, the
@@ -1414,6 +1609,15 @@ class Client:
                     if stats is not None and delta:
                         # The ship failed — put the delta back so the next
                         # beat re-sends it instead of silently losing it.
+                        stats.requeue_delta(delta)
+                    self._queue_beat(pending, payload)
+                except ValueError:
+                    # Frame too large (send_msg's MAX_FRAME guard): the
+                    # banked batch can never ship — drop it rather than
+                    # retry-grow it forever or kill this thread (a dead
+                    # heartbeat thread reads as runner death).
+                    pending = []
+                    if stats is not None and delta:
                         stats.requeue_delta(delta)
                 self._hb_stop.wait(self.hb_interval)
 

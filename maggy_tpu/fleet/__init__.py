@@ -19,12 +19,13 @@ submissions for cross-process use); live view:
 
 from maggy_tpu.fleet.scheduler import (FLEET_JOURNAL_NAME, ExperimentEntry,
                                        Fleet, FleetBinding, FleetLeasedPool,
-                                       FleetPolicy, FleetScheduler,
-                                       FleetSubmission, priority_rank,
-                                       replay_fleet_journal)
+                                       FleetPolicy, FleetSaturated,
+                                       FleetScheduler, FleetSubmission,
+                                       priority_rank, replay_fleet_journal)
 
 __all__ = [
-    "Fleet", "FleetPolicy", "FleetScheduler", "FleetBinding",
-    "FleetLeasedPool", "FleetSubmission", "ExperimentEntry",
-    "FLEET_JOURNAL_NAME", "priority_rank", "replay_fleet_journal",
+    "Fleet", "FleetPolicy", "FleetSaturated", "FleetScheduler",
+    "FleetBinding", "FleetLeasedPool", "FleetSubmission",
+    "ExperimentEntry", "FLEET_JOURNAL_NAME", "priority_rank",
+    "replay_fleet_journal",
 ]

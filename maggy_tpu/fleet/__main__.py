@@ -75,25 +75,60 @@ def _submit_spec(fleet, spec: Dict[str, Any], handles: Dict[str, Any],
 
 
 def _drain_spool(fleet, env, spool: str, handles: Dict[str, Any],
-                 base_dir=None) -> int:
+                 base_dir=None, seen=None) -> int:
     """Claim and submit every unclaimed spec in the spool dir. The claim
-    marker (exclusive_create) makes multiple hosts/restarts safe."""
+    marker (exclusive_create) makes multiple hosts/restarts safe.
+
+    Bounded scan: ``seen`` (caller-held, persisted across polls) records
+    every spec name already resolved — claimed by us, observed claimed
+    by another feeder, or submitted — so a poll over a spool holding
+    thousands of processed specs costs ONE directory listing plus
+    set-membership checks, not O(files) ``exists`` round trips per
+    claim. Claim markers are only consulted for names this process has
+    never resolved. A saturated fleet (admission queue at its
+    ``max_queued`` bound) stops the drain WITHOUT claiming: unclaimed
+    specs are the spool's natural backpressure buffer, picked up again
+    once the queue drains — claiming and shedding would lose them."""
+    from maggy_tpu.fleet.scheduler import FleetSaturated
+
     n = 0
     for name in sorted(env.ls(spool)):
         if not name.endswith(".json"):
             continue
+        if seen is not None and name in seen:
+            continue
+        if fleet.scheduler.saturated():
+            break
         path = "{}/{}".format(spool, name)
         marker = path + ".claimed"
         if env.exists(marker):
+            if seen is not None:
+                seen.add(name)
             continue
         if not env.exclusive_create(
                 json.dumps({"claimed_at": time.time(),
                             "pid": os.getpid()}), marker):
+            if seen is not None:
+                seen.add(name)
             continue
+        if seen is not None:
+            seen.add(name)
         try:
             _submit_spec(fleet, json.loads(env.load(path)), handles,
                          base_dir=base_dir)
             n += 1
+        except FleetSaturated:
+            # Raced past the pre-claim check (a concurrent submit filled
+            # the queue): un-burn the claim so the spec is retried — by
+            # this host or any other — once the queue drains. Losing it
+            # would contradict the spool's backpressure contract.
+            try:
+                env.delete(marker)
+            except Exception:  # noqa: BLE001 - a stuck marker only delays the retry
+                pass
+            if seen is not None:
+                seen.discard(name)
+            break
         except Exception as e:  # noqa: BLE001 - one bad spec must not kill the host
             print("bad submission {}: {!r}".format(name, e),
                   file=sys.stderr, flush=True)
@@ -107,10 +142,12 @@ def _cmd_start(args) -> int:
     env = EnvSing.get_instance()
     fleet = Fleet(runners=args.runners, name=args.name,
                   home_dir=args.home, max_active=args.max_active,
+                  max_queued=args.max_queued,
                   preempt_grace_s=args.preempt_grace)
     spool = fleet.home_dir + "/queue"
     env.mkdir(spool)
     handles: Dict[str, Any] = {}
+    seen: set = set()
     with fleet:
         print("fleet {!r}: {} runner(s), home {}".format(
             fleet.name, fleet.num_runners, fleet.home_dir), flush=True)
@@ -121,7 +158,8 @@ def _cmd_start(args) -> int:
                 _submit_spec(fleet, spec, handles, base_dir=args.base_dir)
         idle_since = None
         while True:
-            _drain_spool(fleet, env, spool, handles, base_dir=args.base_dir)
+            _drain_spool(fleet, env, spool, handles,
+                         base_dir=args.base_dir, seen=seen)
             pending = [h for h in handles.values() if not h.done()]
             if pending:
                 idle_since = None
@@ -167,9 +205,16 @@ def _cmd_status(args) -> int:
 
 
 def _cmd_soak(args) -> int:
-    from maggy_tpu.fleet.soak import run_fleet_soak
+    from maggy_tpu.fleet.soak import run_fleet_soak, run_slow_tenant_soak
 
-    report = run_fleet_soak(runners=args.runners, seed=args.seed)
+    if args.slow_tenant:
+        # Witness on by default, like the chaos CLI's soaks: the
+        # isolation run doubles as a dynamic lock-order check.
+        report = run_slow_tenant_soak(
+            seed=args.seed, dispatch_pool=not args.no_dispatch_pool,
+            lock_witness=True)
+    else:
+        report = run_fleet_soak(runners=args.runners, seed=args.seed)
     print(json.dumps(report, indent=2, default=str))
     return 0 if report["ok"] else 1
 
@@ -189,6 +234,11 @@ def main(argv=None) -> int:
     ps.add_argument("--max-active", type=int, default=None,
                     help="admission cap: concurrent experiments competing "
                          "for runners (default unbounded)")
+    ps.add_argument("--max-queued", type=int, default=None,
+                    help="admission-queue bound: submissions past it are "
+                         "shed (journaled 'shed' events); the spool "
+                         "feeder stops claiming while saturated "
+                         "(default unbounded)")
     ps.add_argument("--preempt-grace", type=float, default=1.0,
                     help="seconds an experiment may sit below its "
                          "guaranteed allocation before the scheduler "
@@ -215,6 +265,16 @@ def main(argv=None) -> int:
     pk = sub.add_parser("soak", help="run the built-in preemption soak")
     pk.add_argument("--runners", type=int, default=2)
     pk.add_argument("--seed", type=int, default=7)
+    pk.add_argument("--slow-tenant", action="store_true",
+                    help="run the slow-tenant isolation soak instead: one "
+                         "tenant's handlers artificially delayed, other "
+                         "tenants' hand-off p95 must stay in bound "
+                         "(run under the lock-order witness)")
+    pk.add_argument("--no-dispatch-pool", action="store_true",
+                    help="slow-tenant soak only: disable the per-tenant "
+                         "dispatch pools (the pre-fix shared-loop "
+                         "behavior) — for A/B comparison; the isolation "
+                         "invariant is expected to FAIL in this mode")
 
     args = p.parse_args(argv)
     return {"start": _cmd_start, "submit": _cmd_submit,

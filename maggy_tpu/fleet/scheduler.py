@@ -45,6 +45,7 @@ runner track (``python -m maggy_tpu.telemetry trace <fleet_home>``).
 
 from __future__ import annotations
 
+import heapq
 import itertools
 import json
 import os
@@ -59,6 +60,21 @@ FLEET_JOURNAL_NAME = "fleet.jsonl"
 
 #: Named priority classes (lower rank = served first). Ints pass through.
 PRIORITY_CLASSES = {"high": 0, "normal": 1, "low": 2}
+
+#: How long a computed fair-share target table may be reused before the
+#: next binding/preemption decision recomputes it. Structural changes
+#: (admit/finish/activate) invalidate it immediately; the TTL only covers
+#: live-read drift (a driver flipping experiment_done between ticks),
+#: and matches the scheduler's own 0.1-0.2 s decision cadence.
+TARGETS_TTL_S = 0.05
+
+
+class FleetSaturated(RuntimeError):
+    """Admission shedding: the fleet's submission queue is at its
+    ``max_queued`` bound — the submission was refused (and journaled as
+    a ``shed`` event) instead of queued unboundedly. Callers back off
+    and resubmit; the spool feeder simply leaves specs unclaimed until
+    the queue drains."""
 
 
 def priority_rank(priority) -> int:
@@ -183,14 +199,33 @@ class FleetScheduler:
 
     def __init__(self, fleet_size: int, telemetry=None,
                  max_active: Optional[int] = None,
-                 preempt_grace_s: float = 1.0):
+                 preempt_grace_s: float = 1.0,
+                 max_queued: Optional[int] = None):
         self.fleet_size = int(fleet_size)
         self.telemetry = telemetry
         self.max_active = max_active
+        self.max_queued = max_queued
         self.preempt_grace_s = float(preempt_grace_s)
         self._lock = threading.RLock()
         self._wake = threading.Condition(self._lock)
         self._entries: Dict[str, ExperimentEntry] = {}  # guarded-by: _lock
+        # Indexes keeping every per-decision sweep O(active), not
+        # O(submitted): the ADMITTED set (binding, targets, preemption
+        # all iterate only this — at most max_active entries no matter
+        # how many hundreds sit queued behind it) and the admission
+        # queue as a (rank, seq) heap popped lazily, so admitting one
+        # experiment is O(log queued) instead of re-sorting every
+        # queued entry per submit/finish.
+        self._active: Dict[str, ExperimentEntry] = {}  # guarded-by: _lock
+        self._queued_heap: List[Tuple[int, int, str]] = []  # guarded-by: _lock
+        self._queued_count = 0  # guarded-by: _lock
+        # Cached fair-share target table (the waterfill is O(active *
+        # rounds)): invalidated on structural change, TTL-bounded
+        # otherwise, so a burst of next_binding calls between changes
+        # shares one computation.
+        self._targets_cache: Optional[Dict[str, int]] = None  # guarded-by: _lock
+        self._targets_stamp = 0.0  # guarded-by: _lock
+        self.shed_count = 0  # guarded-by: _lock
         # Final snapshots of completed experiments (bounded): finished
         # entries leave _entries so scheduling decisions stay O(live)
         # and a long-lived fleet host doesn't grow without bound.
@@ -213,8 +248,27 @@ class FleetScheduler:
                 raise ValueError(
                     "experiment {!r} is already submitted to this "
                     "fleet".format(name))
+            if self.max_queued is not None \
+                    and self._queued_count >= self.max_queued:
+                # Admission shedding: refuse instead of queueing without
+                # bound — a saturated control plane must say so, not
+                # absorb submissions into an ever-slower backlog.
+                self.shed_count += 1
+                self._event("shed", exp=name, scope="admission",
+                            queued=self._queued_count)
+                telem = self.telemetry
+                if telem is not None:
+                    telem.metrics.counter("fleet.shed_total").inc()
+                raise FleetSaturated(
+                    "fleet admission queue is full ({} queued, bound {}); "
+                    "submission {!r} shed — resubmit after the queue "
+                    "drains".format(self._queued_count, self.max_queued,
+                                    name))
             entry = ExperimentEntry(name, policy, next(self._seq))
             self._entries[name] = entry
+            self._queued_count += 1
+            heapq.heappush(self._queued_heap,
+                           (policy.rank, entry.seq, name))
             self._event("fleet_submit", exp=name, **policy.to_dict())
             self._admit_locked()
             self._wake.notify_all()
@@ -222,17 +276,23 @@ class FleetScheduler:
 
     # locked-by: _lock
     def _admit_locked(self) -> None:
-        active = sum(1 for e in self._entries.values()
-                     if e.state == "active")
-        queued = sorted((e for e in self._entries.values()
-                         if e.state == "queued"),
-                        key=lambda e: (e.policy.rank, e.seq))
-        for entry in queued:
-            if self.max_active is not None and active >= self.max_active:
+        """Admit from the (rank, seq) heap up to ``max_active``. Heap
+        entries are popped lazily: an entry that finished (or was never
+        created) while queued is skipped, so admission stays O(log
+        queued) per admit with no rebuild on finish."""
+        while self._queued_heap:
+            if self.max_active is not None \
+                    and len(self._active) >= self.max_active:
                 break
+            _rank, _seq, name = heapq.heappop(self._queued_heap)
+            entry = self._entries.get(name)
+            if entry is None or entry.state != "queued":
+                continue  # finished/failed while queued: lazy deletion
             entry.state = "active"
             entry.admitted_t = time.time()
-            active += 1
+            self._active[name] = entry
+            self._queued_count -= 1
+            self._targets_cache = None
             self._event("fleet_admit", exp=entry.name,
                         queued_s=round(entry.admitted_t
                                        - entry.submitted_t, 3))
@@ -248,15 +308,44 @@ class FleetScheduler:
             entry.slots = int(slots)
             entry.free_pids = set(range(int(slots)))
             entry.exp_dir = getattr(driver, "exp_dir", None)
+            self._targets_cache = None
             self._event("fleet_experiment", exp=entry.name, phase="start",
                         slots=entry.slots, exp_dir=entry.exp_dir)
             self._wake.notify_all()
+
+    def wait_admitted(self, entry: ExperimentEntry,
+                      timeout: Optional[float] = None) -> bool:
+        """Block until ``entry`` is admitted past the queue (True) or the
+        fleet stops / the entry finishes first (False). The deferred-
+        activation hook: a submission thread builds its driver only after
+        this returns True, so a thousand queued tenants cost a thousand
+        heap entries — not a thousand live drivers."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if entry.state == "active":
+                    return True
+                if self.stopped or entry.state in ("done", "failed"):
+                    return False
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return False
+                    self._wake.wait(timeout=min(left, 0.2))
+                else:
+                    self._wake.wait(timeout=0.2)
 
     def finish(self, entry: ExperimentEntry, state: str = "done") -> None:
         with self._lock:
             if entry.state in ("done", "failed"):
                 return
+            was_queued = entry.state == "queued"
             entry.state = state
+            if was_queued:
+                self._queued_count -= 1  # heap entry reaped lazily
+            else:
+                self._active.pop(entry.name, None)
+            self._targets_cache = None
             self._event("fleet_experiment", exp=entry.name, phase=state)
             # A finished experiment's gang block must not park runners
             # forever (the driver normally releases it, but a crashed
@@ -281,12 +370,29 @@ class FleetScheduler:
 
     # locked-by: _lock
     def _targets_locked(self) -> Dict[str, int]:
+        """Cached wrapper around the fair-share waterfill: structural
+        changes (admit/finish/activate) clear the cache; otherwise a
+        short TTL bounds staleness to the scheduler's own decision
+        cadence. Keeps a burst of binding decisions from recomputing an
+        identical table per free runner."""
+        now = time.monotonic()
+        cached = self._targets_cache
+        if cached is not None and now - self._targets_stamp < TARGETS_TTL_S:
+            return cached
+        targets = self._compute_targets_locked()
+        self._targets_cache = targets
+        self._targets_stamp = now
+        return targets
+
+    # locked-by: _lock
+    def _compute_targets_locked(self) -> Dict[str, int]:
         """Per-experiment runner target: min_runners first in priority
         order, then leftover capacity waterfilled class by class with a
         weighted largest-remainder split, clamped to each experiment's
         effective max. This is the allocation both binding and preemption
-        steer toward."""
-        active = [e for e in self._entries.values()
+        steer toward. Iterates the ADMITTED index only — queued tenants
+        cannot deserve runners, so they must not cost sweep time."""
+        active = [e for e in self._active.values()
                   if e.ready() and not (e.driver is not None
                                         and e.driver.experiment_done)]
         targets = {e.name: 0 for e in active}
@@ -432,7 +538,7 @@ class FleetScheduler:
         now = time.monotonic()
         best = None
         best_key = None
-        for e in self._entries.values():
+        for e in self._active.values():
             if not e.wants_runners():
                 continue
             if e.allocated() >= e.effective_max(self.fleet_size):
@@ -496,7 +602,7 @@ class FleetScheduler:
             if self.stopped:
                 return 0
             targets = self._targets_locked()
-            for e in self._entries.values():
+            for e in self._active.values():
                 if not e.wants_runners():
                     e.deficit_since = None
                     continue
@@ -559,7 +665,7 @@ class FleetScheduler:
                        ) -> Optional[ExperimentEntry]:
         now = time.monotonic()
         candidates = []
-        for v in self._entries.values():
+        for v in self._active.values():
             if v is starving or v.state != "active" or not v.open_leases:
                 continue
             if v.allocated() - 1 < min(v.policy.min_runners,
@@ -594,12 +700,20 @@ class FleetScheduler:
             entries = sorted(self._entries.values(), key=lambda e: e.seq)
             return {
                 "fleet_size": self.fleet_size,
-                "queue_depth": sum(1 for e in entries
-                                   if e.state == "queued"),
-                "active": sum(1 for e in entries if e.state == "active"),
+                "queue_depth": self._queued_count,
+                "active": len(self._active),
+                "shed": self.shed_count,
+                "max_queued": self.max_queued,
                 "experiments": list(self._finished)
                 + [e.snapshot() for e in entries],
             }
+
+    def saturated(self) -> bool:
+        """True while new submissions would be shed (``max_queued``
+        reached) — the spool feeder's stop-claiming signal."""
+        with self._lock:
+            return self.max_queued is not None \
+                and self._queued_count >= self.max_queued
 
     def _event(self, ev: str, **fields: Any) -> None:
         telem = self.telemetry
@@ -739,9 +853,11 @@ class Fleet:
     def __init__(self, runners: int = 2, *, pool: str = "thread",
                  name: str = "fleet", home_dir: Optional[str] = None,
                  env=None, max_active: Optional[int] = None,
+                 max_queued: Optional[int] = None,
                  preempt_grace_s: float = 1.0, telemetry: bool = True,
                  obs_port: Optional[int] = None,
-                 obs_host: str = "127.0.0.1"):
+                 obs_host: str = "127.0.0.1",
+                 dispatch_pool: Optional[bool] = None):
         if pool != "thread":
             raise ValueError(
                 "fleet pools are in-process ('thread'): experiments are "
@@ -763,8 +879,12 @@ class Fleet:
             enabled=telemetry)
         self.scheduler = FleetScheduler(
             self.num_runners, telemetry=self.telemetry,
-            max_active=max_active, preempt_grace_s=preempt_grace_s)
-        self.shared_server = SharedServer()
+            max_active=max_active, max_queued=max_queued,
+            preempt_grace_s=preempt_grace_s)
+        # dispatch_pool=None -> per-tenant handler pools on (the
+        # default; MAGGY_TPU_SHARED_DISPATCH_POOL=0 or False restores
+        # handlers-on-the-loop for A/B measurement).
+        self.shared_server = SharedServer(dispatch_pool=dispatch_pool)
         self._pool_thread: Optional[threading.Thread] = None
         self._tick_thread: Optional[threading.Thread] = None
         self._started = False
@@ -927,6 +1047,15 @@ class Fleet:
         sub = None
         driver = None
         try:
+            # Deferred activation: build the driver (run-dir claim, RPC
+            # server, telemetry, threads) only once the scheduler admits
+            # this tenant past the queue. A churn of hundreds of queued
+            # submissions costs hundreds of heap entries and parked
+            # threads — not hundreds of live control planes.
+            if not self.scheduler.wait_admitted(entry):
+                raise RuntimeError(
+                    "fleet {!r} stopped before experiment {!r} was "
+                    "admitted".format(self.name, entry.name))
             sub = exp_mod._begin_run(config, self.env, exclusive=False)
             slots = entry.effective_max(self.num_runners)
             replacements = dict(fleet=FleetBinding(self, entry),
@@ -977,18 +1106,30 @@ class Fleet:
 # ----------------------------------------------------------------- replay
 
 
-def replay_fleet_journal(path: str, env=None) -> Dict[str, Any]:
+def replay_fleet_journal(path: str, env=None,
+                         share_names=None) -> Dict[str, Any]:
     """Offline replay of a fleet journal: per-experiment queue waits,
     lease-derived runner-seconds, share fractions over the window where
-    experiments overlapped (vs the weight-expected split), and preemption
-    counts. Pure — the same journal always reproduces the same numbers
-    (bench.py's ``detail.fleet`` block is exactly this call)."""
+    experiments overlapped (vs the weight-expected split), preemption
+    counts, admission latency (submit -> admit), shed counts, and
+    scheduler decision throughput. Pure — the same journal always
+    reproduces the same numbers (bench.py's ``detail.fleet`` /
+    ``detail.scale`` blocks are exactly this call).
+
+    ``share_names``: restrict the fair-share computation to this subset
+    of experiments. Under churn the overlap window of ALL experiments is
+    empty (cohorts start and finish at different times), so the share
+    check runs over the long-lived resident cohort instead."""
     from maggy_tpu.telemetry import read_events
     from maggy_tpu.telemetry.spans import _dist_stats
 
     events = read_events(path, env=env)
     exps: Dict[str, Dict[str, Any]] = {}
     preempts = 0
+    sheds = 0
+    admission_ms: List[float] = []
+    decisions = 0
+    first_t: Optional[float] = None
     last_t = 0.0
 
     def exp(name: str) -> Dict[str, Any]:
@@ -1002,7 +1143,18 @@ def replay_fleet_journal(path: str, env=None) -> Dict[str, Any]:
         if isinstance(t, (int, float)):
             last_t = max(last_t, t)
         kind = ev.get("ev")
-        if kind == "fleet_submit":
+        if kind in ("fleet_admit", "lease", "preempt", "shed"):
+            # Scheduler decisions: admissions, lease grants/releases,
+            # preemptions, sheds — the control plane's output rate.
+            decisions += 1
+            if isinstance(t, (int, float)):
+                first_t = t if first_t is None else min(first_t, t)
+        if kind == "shed":
+            sheds += 1
+        elif kind == "fleet_admit":
+            if ev.get("queued_s") is not None:
+                admission_ms.append(float(ev["queued_s"]) * 1e3)
+        elif kind == "fleet_submit":
             e = exp(ev["exp"])
             e["submitted_t"] = t
             e["weight"] = float(ev.get("weight", 1.0))
@@ -1054,7 +1206,8 @@ def replay_fleet_journal(path: str, env=None) -> Dict[str, Any]:
     share: Dict[str, float] = {}
     expected: Dict[str, float] = {}
     share_error = None
-    leased = {n: e for n, e in exps.items() if e["leases"]}
+    leased = {n: e for n, e in exps.items() if e["leases"]
+              and (share_names is None or n in share_names)}
     if len(leased) >= 2:
         w0 = max(min(t0 for t0, _ in e["leases"]) for e in leased.values())
         w1 = min(max(t1 for _, t1 in e["leases"]) for e in leased.values())
@@ -1072,14 +1225,34 @@ def replay_fleet_journal(path: str, env=None) -> Dict[str, Any]:
                 share_error = round(
                     max(abs(share[n] - expected[n]) for n in share), 3)
 
+    window_s = None
+    if first_t is not None and last_t > first_t:
+        window_s = last_t - first_t
+    admission_sorted = sorted(admission_ms)
+    admission_p99 = None
+    if admission_sorted:
+        admission_p99 = round(
+            admission_sorted[min(len(admission_sorted) - 1,
+                                 int(0.99 * len(admission_sorted)))], 3)
     return {
         "experiments": out_exps,
         "preemptions": preempts,
+        "sheds": sheds,
         "share": share,
         "expected_share": expected,
         "share_error": share_error,
         "queue_wait_ms": _dist_stats(queue_waits_ms),
         "max_queue_wait_s": round(max(queue_waits_ms) / 1e3, 3)
         if queue_waits_ms else None,
+        # Admission latency: fleet_submit -> fleet_admit, per admitted
+        # experiment (the scheduler's own queued_s measurement).
+        "admission_ms": _dist_stats(admission_ms),
+        "admission_p99_ms": admission_p99,
+        # Scheduler decision throughput over the decision window:
+        # admits + lease starts/ends + preempts + sheds per second.
+        "decisions": decisions,
+        "decision_window_s": round(window_s, 3) if window_s else None,
+        "decisions_per_s": round(decisions / window_s, 2)
+        if window_s else None,
         "torn_lines": getattr(events, "torn_lines", 0),
     }

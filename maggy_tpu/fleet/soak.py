@@ -15,6 +15,7 @@ share error).
 from __future__ import annotations
 
 import glob
+import json
 import os
 import tempfile
 import time
@@ -165,3 +166,520 @@ def run_fleet_soak(runners: int = 2, bulk_trials: int = 6,
             "preempted": preempted_total,
             "replay": replay, "journal": journal, "detail": detail,
             "base_dir": base_dir}
+
+
+# --------------------------------------------------------------- scale soaks
+
+
+def scale_train_fn(lr, units, reporter=None, ctx=None):
+    """Cheapest possible tenant trial: pure python, one broadcast — the
+    measurement is the control plane (admission, leasing, RPC, journal),
+    never compute. Module-level so spool spec files can name it
+    (``maggy_tpu.fleet.soak:scale_train_fn``)."""
+    value = 1.0 / (1.0 + abs(lr - 0.1) + units / 1e4)
+    if reporter is not None:
+        reporter.broadcast(value, step=0)
+    return {"metric": value}
+
+
+def resident_train_fn(lr, units, reporter=None, ctx=None):
+    """Fair-share resident trial: ~0.1 s of wall per trial so resident
+    tenants hold leases long enough for share accounting to mean
+    something while cheap tenants churn around them."""
+    import time as _time
+
+    value = 1.0 / (1.0 + abs(lr - 0.1) + units / 1e4)
+    for step in range(2):
+        if reporter is not None:
+            reporter.broadcast(value * (step + 1), step=step)
+        _time.sleep(0.05)
+    return {"metric": value}
+
+
+def _scale_config(name: str, trials: int, base_dir: str, seed: int,
+                  hb_interval: float = 0.25, telemetry: bool = False):
+    """Config for a cheap churn tenant: per-experiment telemetry and the
+    health engine OFF — the fleet journal carries every scheduling fact
+    the gates replay, and 500 concurrent journals/flushers/engines would
+    measure journal fan-out, not the scheduler."""
+    from maggy_tpu import OptimizationConfig, Searchspace
+
+    return OptimizationConfig(
+        name=name, num_trials=trials, optimizer="randomsearch",
+        searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2]),
+                                units=("INTEGER", [8, 64])),
+        direction="max", hb_interval=hb_interval, hb_loss_timeout=10.0,
+        seed=seed, es_policy="none", experiment_dir=base_dir,
+        telemetry=telemetry, health=False, verbose=False)
+
+
+def run_scale_churn(experiments: int = 520, runners: int = 8,
+                    max_active: int = 12, spool_specs: int = 24,
+                    trials_per_exp: int = 1, seed: int = 7,
+                    base_dir: Optional[str] = None,
+                    max_queued: Optional[int] = None,
+                    result_timeout_s: float = 900.0,
+                    min_decisions_per_s: float = 10.0,
+                    admission_p99_bound_s: Optional[float] = None
+                    ) -> Dict[str, Any]:
+    """Churn soak: hammer ONE fleet with ``experiments`` concurrent cheap
+    tenants — most via ``lagom_submit``, a slice via the spool path the
+    CLI host uses — and gate the control plane's replayed numbers:
+
+    - every admitted tenant completes its full schedule (no lost trials,
+      no stuck admissions);
+    - scheduler decision throughput (admits + leases + preempts + sheds
+      per second) stays above ``min_decisions_per_s``;
+    - admission latency p99 stays under ``admission_p99_bound_s``
+      (default: the soak's own wall — i.e. the queue drains steadily
+      instead of parking a cohort until the end).
+
+    Deferred activation bounds live drivers to ``max_active`` no matter
+    how many hundreds queue, which is what makes this shape feasible in
+    one process at all."""
+    from maggy_tpu import experiment
+    from maggy_tpu.core.environment import EnvSing
+    from maggy_tpu.fleet.__main__ import _drain_spool
+
+    base_dir = base_dir or tempfile.mkdtemp(prefix="maggy_scale_")
+    env = EnvSing.get_instance()
+    t0 = time.time()
+    fleet = Fleet(runners=runners, home_dir=os.path.join(base_dir, "fleet"),
+                  max_active=max_active, max_queued=max_queued,
+                  preempt_grace_s=5.0)
+    direct = max(0, experiments - spool_specs)
+    handles = {}
+    spool_handles: Dict[str, Any] = {}
+    failures: Dict[str, str] = {}
+    shed = 0
+    with fleet:
+        spool = fleet.home_dir + "/queue"
+        env.mkdir(spool)
+        for i in range(spool_specs):
+            spec = {"name": "spool{:04d}".format(i),
+                    "train_fn": "maggy_tpu.fleet.soak:scale_train_fn",
+                    "config": {"num_trials": trials_per_exp,
+                               "optimizer": "randomsearch",
+                               "direction": "max", "seed": seed + i,
+                               "es_policy": "none", "telemetry": False,
+                               "health": False, "hb_interval": 0.25,
+                               "searchspace": {
+                                   "lr": ["DOUBLE", [0.0, 0.2]],
+                                   "units": ["INTEGER", [8, 64]]}}}
+            env.dump(json.dumps(spec),
+                     "{}/spool{:04d}.json".format(spool, i))
+        from maggy_tpu.fleet.scheduler import FleetSaturated
+
+        for i in range(direct):
+            name = "churn{:04d}".format(i)
+            try:
+                handles[name] = experiment.lagom_submit(
+                    scale_train_fn,
+                    _scale_config(name, trials_per_exp, base_dir, seed + i),
+                    fleet=fleet, block=False, name=name)
+            except FleetSaturated:
+                shed += 1  # expected under a max_queued bound
+            except Exception as e:  # noqa: BLE001 - anything else is a real failure
+                failures[name] = repr(e)
+        # Spool drain with the bounded (seen-set) scan, like the CLI host.
+        seen: set = set()
+        deadline = time.monotonic() + result_timeout_s
+        while len(spool_handles) < spool_specs \
+                and time.monotonic() < deadline:
+            _drain_spool(fleet, env, spool, spool_handles,
+                         base_dir=base_dir, seen=seen)
+            if len(spool_handles) < spool_specs:
+                time.sleep(0.2)
+        handles.update(spool_handles)
+        for name, handle in sorted(handles.items()):
+            try:
+                left = max(1.0, deadline - time.monotonic())
+                result = handle.result(timeout=left)
+                if result.get("num_trials") != trials_per_exp:
+                    failures[name] = "finished {} of {} trials".format(
+                        result.get("num_trials"), trials_per_exp)
+            except BaseException as e:  # noqa: BLE001 - one tenant's failure is a finding
+                failures[name] = repr(e)
+    wall_s = time.time() - t0
+
+    journal = os.path.join(fleet.home_dir, FLEET_JOURNAL_NAME)
+    replay = replay_fleet_journal(journal)
+    violations: List[str] = []
+    if failures:
+        sample = dict(list(sorted(failures.items()))[:5])
+        violations.append(
+            "{} of {} tenants failed/incomplete (sample: {})".format(
+                len(failures), len(handles) + len(failures), sample))
+    rate = replay.get("decisions_per_s")
+    if rate is not None and rate < min_decisions_per_s:
+        violations.append(
+            "decision throughput {:.1f}/s under the {:.0f}/s "
+            "floor".format(rate, min_decisions_per_s))
+    p99_bound = admission_p99_bound_s \
+        if admission_p99_bound_s is not None else wall_s
+    p99 = replay.get("admission_p99_ms")
+    if p99 is not None and p99 > p99_bound * 1e3:
+        violations.append(
+            "admission latency p99 {:.0f} ms over the {:.0f} ms bound "
+            "(queue not draining steadily)".format(p99, p99_bound * 1e3))
+    detail = {
+        "experiments": len(handles), "spooled": len(spool_handles),
+        # failures may include submit-time names that never got a handle
+        # — only subtract the ones that did.
+        "completed": len(handles) - sum(1 for n in failures
+                                        if n in handles),
+        "failed": len(failures),
+        # The journal is the source of truth for sheds — the scheduler
+        # journals each refusal before raising, so counting the local
+        # FleetSaturated tally on top would double-count them.
+        "shed": replay.get("sheds", 0),
+        "wall_s": round(wall_s, 1),
+        "experiments_per_s": round(len(handles) / wall_s, 2)
+        if wall_s > 0 else None,
+        "admission_ms": replay["admission_ms"],
+        "admission_p99_ms": replay["admission_p99_ms"],
+        "decisions": replay["decisions"],
+        "decisions_per_s": replay["decisions_per_s"],
+        "queue_wait_ms": replay["queue_wait_ms"],
+        "preemptions": replay["preemptions"],
+    }
+    return {"ok": not violations, "violations": violations,
+            "detail": detail, "journal": journal, "base_dir": base_dir}
+
+
+def run_weighted_share_soak(runners: int = 4, trials: int = 12,
+                            seed: int = 7,
+                            base_dir: Optional[str] = None,
+                            share_error_bound: float = 0.35
+                            ) -> Dict[str, Any]:
+    """Fair-share phase: three resident tenants with weights 1/1/2 run
+    concurrently; the journal-replayed share split over their overlap
+    window must sit within ``share_error_bound`` of the weight-expected
+    split."""
+    from maggy_tpu import experiment
+
+    base_dir = base_dir or tempfile.mkdtemp(prefix="maggy_share_")
+    weights = {"res_a": 1.0, "res_b": 1.0, "res_c": 2.0}
+    t0 = time.time()
+    fleet = Fleet(runners=runners,
+                  home_dir=os.path.join(base_dir, "fleet"))
+    with fleet:
+        handles = {
+            name: experiment.lagom_submit(
+                resident_train_fn,
+                _scale_config(name, trials, base_dir, seed + i,
+                              hb_interval=0.05),
+                fleet=fleet, weight=weights[name], block=False, name=name)
+            for i, name in enumerate(sorted(weights))}
+        results = {n: h.result(timeout=300) for n, h in handles.items()}
+    wall_s = time.time() - t0
+    journal = os.path.join(fleet.home_dir, FLEET_JOURNAL_NAME)
+    replay = replay_fleet_journal(journal, share_names=set(weights))
+    violations: List[str] = []
+    for name in sorted(weights):
+        if results[name].get("num_trials") != trials:
+            violations.append("{} finished {} of {} trials".format(
+                name, results[name].get("num_trials"), trials))
+    if replay["share_error"] is None:
+        violations.append("no overlap window: share error not computable")
+    elif replay["share_error"] > share_error_bound:
+        violations.append(
+            "fair-share error {} over the {} bound (shares {}, expected "
+            "{})".format(replay["share_error"], share_error_bound,
+                         replay["share"], replay["expected_share"]))
+    detail = {"share": replay["share"],
+              "expected_share": replay["expected_share"],
+              "share_error": replay["share_error"],
+              "wall_s": round(wall_s, 1)}
+    return {"ok": not violations, "violations": violations,
+            "detail": detail, "journal": journal, "base_dir": base_dir}
+
+
+def slow_victim_train_fn(lr, units, reporter=None, ctx=None):
+    """Victim-tenant trial for the slow-tenant soak: a few broadcasts
+    with a short wall so hand-off gaps dominate the measurement."""
+    import time as _time
+
+    value = 1.0 / (1.0 + abs(lr - 0.1) + units / 1e4)
+    for step in range(3):
+        if reporter is not None:
+            reporter.broadcast(value * (step + 1), step=step)
+        _time.sleep(0.02)
+    return {"metric": value}
+
+
+def slow_tenant_train_fn(lr, units, reporter=None, ctx=None):
+    """Slow-tenant trial: ~4 s of broadcasting wall per trial, so the
+    tenant keeps heartbeating (each beat's handler artificially delayed
+    by the soak's injection) for the whole window the victims sweep in —
+    the overlap is what makes the head-of-line measurement mean
+    anything."""
+    import time as _time
+
+    value = 1.0 / (1.0 + abs(lr - 0.1) + units / 1e4)
+    for step in range(80):
+        if reporter is not None:
+            reporter.broadcast(value * (step + 1), step=step)
+        _time.sleep(0.05)
+    return {"metric": value}
+
+
+def run_slow_tenant_soak(runners: int = 3, victims: int = 2,
+                         victim_trials: int = 6, slow_trials: int = 2,
+                         delay_ms: float = 150.0,
+                         dispatch_pool: Optional[bool] = True,
+                         seed: int = 7,
+                         base_dir: Optional[str] = None,
+                         handoff_p95_bound_ms: float = 150.0,
+                         victim_rtt_bound_ms: float = 50.0,
+                         lock_witness: Optional[bool] = None
+                         ) -> Dict[str, Any]:
+    """Head-of-line-isolation soak (the chaos side of the dispatch-pool
+    refactor): one tenant's handlers are artificially delayed by
+    ``delay_ms`` per heartbeat/FINAL (journaled as a ``chaos`` event,
+    kind ``slow_tenant``), while ``victims`` ordinary tenants run their
+    sweeps on the same shared listener. Invariants:
+
+    - every victim completes with clean journal invariants (no lost
+      trials, single FINALs);
+    - every victim's journal-replayed hand-off p95 stays under
+      ``handoff_p95_bound_ms`` (driver-side dispatch health);
+    - every victim's journaled heartbeat RTT stays under
+      ``victim_rtt_bound_ms`` — THE head-of-line signal: the RTT is
+      measured client-side around the whole request, so shared-loop
+      queueing behind the slow tenant's delayed handlers shows up here
+      (the span-derived hand-off gap cannot see it: with the FINAL
+      piggyback, ``finalized`` and ``running`` are journaled inside one
+      dispatch).
+
+    With ``dispatch_pool=False`` (the pre-fix shared-loop dispatch) the
+    RTT invariant is EXPECTED to fail — bench.py --scale runs exactly
+    that A/B and reports both sides. ``lock_witness`` arms the runtime
+    lock-order witness like the chaos soaks do; any forbidden edge is a
+    violation."""
+    import threading
+
+    from maggy_tpu import experiment
+    from maggy_tpu.analysis import witness as _witness
+    from maggy_tpu.chaos.harness import check_invariants
+    from maggy_tpu.telemetry import JOURNAL_NAME, read_events
+    from maggy_tpu.telemetry.spans import derive
+
+    wit = None
+    wit_installed_here = False
+    wit_pre_violations = 0
+    if lock_witness or (lock_witness is None and _witness.enabled_by_env()):
+        wit_installed_here = _witness.active_witness() is None
+        wit = _witness.install()
+        wit_pre_violations = len(wit.violations)
+
+    base_dir = base_dir or tempfile.mkdtemp(prefix="maggy_slowten_")
+    delay_s = delay_ms / 1e3
+    t0 = time.time()
+    injected = {"n": 0}
+    fleet = Fleet(runners=runners,
+                  home_dir=os.path.join(base_dir, "fleet"),
+                  dispatch_pool=dispatch_pool)
+    try:
+        with fleet:
+            slow = experiment.lagom_submit(
+                slow_tenant_train_fn,
+                _scale_config("slow", slow_trials, base_dir, seed,
+                              hb_interval=0.02, telemetry=True),
+                fleet=fleet, max_runners=1, block=False, name="slow")
+
+            def inject():
+                # Wrap the slow tenant's handler path the moment its
+                # driver/server exist: every subsequent METRIC/BATCH/
+                # FINAL it handles sleeps ``delay_s`` first — on the
+                # shared LOOP without pools, in its OWN dispatcher with
+                # them. That asymmetry is the whole experiment.
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    drv = slow.entry.driver
+                    server = getattr(drv, "server", None) \
+                        if drv is not None else None
+                    if server is not None:
+                        orig = server.handle_message
+
+                        def delayed(msg, _orig=orig):
+                            if msg.get("type") in ("METRIC", "BATCH",
+                                                   "FINAL"):
+                                time.sleep(delay_s)
+                                injected["n"] += 1
+                            return _orig(msg)
+
+                        server.handle_message = delayed
+                        telem = getattr(drv, "telemetry", None)
+                        if telem is not None:
+                            telem.event("chaos", kind="slow_tenant",
+                                        delay_ms=delay_ms)
+                        return
+                    time.sleep(0.005)
+
+            injector = threading.Thread(target=inject, daemon=True,
+                                        name="slow-tenant-injector")
+            injector.start()
+            injector.join(timeout=35.0)
+            victim_handles = {
+                "victim{}".format(i): experiment.lagom_submit(
+                    slow_victim_train_fn,
+                    _scale_config("victim{}".format(i), victim_trials,
+                                  base_dir, seed + 1 + i,
+                                  hb_interval=0.05, telemetry=True),
+                    fleet=fleet, max_runners=1, block=False,
+                    name="victim{}".format(i))
+                for i in range(victims)}
+            results = {n: h.result(timeout=180)
+                       for n, h in victim_handles.items()}
+            results["slow"] = slow.result(timeout=180)
+    finally:
+        if wit is not None and wit_installed_here \
+                and not _witness.enabled_by_env():
+            _witness.uninstall()
+    wall_s = time.time() - t0
+
+    violations: List[str] = []
+    victim_p95: Dict[str, Any] = {}
+    victim_rtt: Dict[str, Any] = {}
+    journals: Dict[str, str] = {}
+    for exp_dir in sorted(d for d in glob.glob(os.path.join(base_dir, "*"))
+                          if os.path.isdir(d) and d != fleet.home_dir):
+        jp = os.path.join(exp_dir, JOURNAL_NAME)
+        if not os.path.exists(jp):
+            continue
+        events = read_events(jp)
+        name = None
+        for ev in events:
+            if ev.get("ev") == "experiment" and ev.get("name"):
+                name = ev["name"]
+                break
+        name = name or os.path.basename(exp_dir)
+        journals[name] = jp
+        rep = check_invariants(events, stall_flag_bound_s=None)
+        violations.extend("{}: {}".format(name, v)
+                          for v in rep["violations"])
+        if "victim" in name:
+            handoff = derive(events).get("handoff") or {}
+            victim_p95[name] = handoff.get("p95_ms")
+            if handoff.get("p95_ms") is not None \
+                    and handoff["p95_ms"] > handoff_p95_bound_ms:
+                violations.append(
+                    "{}: hand-off p95 {} ms over the {} ms isolation "
+                    "bound (slow tenant leaked into this tenant's "
+                    "dispatch path)".format(name, handoff["p95_ms"],
+                                            handoff_p95_bound_ms))
+            rtts = sorted(ev["hb_rtt_ms"] for ev in events
+                          if ev.get("ev") == "runner_stats"
+                          and ev.get("hb_rtt_ms") is not None)
+            victim_rtt[name] = rtts[-1] if rtts else None
+            if rtts and rtts[-1] > victim_rtt_bound_ms:
+                violations.append(
+                    "{}: heartbeat RTT reached {} ms, over the {} ms "
+                    "isolation bound (slow tenant leaked into this "
+                    "tenant's reply path)".format(
+                        name, rtts[-1], victim_rtt_bound_ms))
+    for name, result in sorted(results.items()):
+        want = slow_trials if name == "slow" else victim_trials
+        if result.get("num_trials") != want:
+            violations.append("{} finished {} of {} trials".format(
+                name, result.get("num_trials"), want))
+    if injected["n"] == 0:
+        violations.append("slow_tenant fault never injected: the soak "
+                          "exercised nothing")
+    witness_block = None
+    if wit is not None:
+        new_violations = wit.violations[wit_pre_violations:]
+        witness_block = {"edges": len(wit.edges),
+                         "violations": len(new_violations)}
+        for v in new_violations:
+            violations.append("lock-order witness: {}".format(v))
+    detail = {
+        "dispatch_pool": dispatch_pool,
+        "delay_ms": delay_ms,
+        "injections": injected["n"],
+        "victim_handoff_p95_ms": victim_p95,
+        "handoff_p95_bound_ms": handoff_p95_bound_ms,
+        "victim_reply_rtt_ms": victim_rtt,
+        "victim_rtt_bound_ms": victim_rtt_bound_ms,
+        "wall_s": round(wall_s, 1),
+        "witness": witness_block,
+    }
+    return {"ok": not violations, "violations": violations,
+            "detail": detail, "journals": journals,
+            "witness": witness_block, "base_dir": base_dir}
+
+
+def run_scale_soak(experiments: int = 520, runners: int = 8,
+                   max_active: int = 12, seed: int = 7,
+                   base_dir: Optional[str] = None,
+                   churn_kwargs: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+    """The full ``bench.py --scale`` scenario, importable for tests:
+
+    1. **churn** — ``experiments`` concurrent cheap tenants through one
+       fleet (lagom_submit + spool), gating completion, scheduler
+       decision throughput, and admission latency p99;
+    2. **fair share** — three weighted residents, gating journal-replayed
+       share error;
+    3. **slow-tenant A/B** — the head-of-line isolation proof: victims'
+       hand-off p95 with the per-tenant dispatch pools ON must hold the
+       isolation bound, and the pool-OFF (pre-fix shared-loop) arm must
+       show the inflation the pools remove.
+    """
+    base_dir = base_dir or tempfile.mkdtemp(prefix="maggy_scale_soak_")
+    churn = run_scale_churn(
+        experiments=experiments, runners=runners, max_active=max_active,
+        seed=seed, base_dir=os.path.join(base_dir, "churn"),
+        **(churn_kwargs or {}))
+    share = run_weighted_share_soak(
+        seed=seed, base_dir=os.path.join(base_dir, "share"))
+    pooled = run_slow_tenant_soak(
+        seed=seed, dispatch_pool=True,
+        base_dir=os.path.join(base_dir, "slow_pooled"))
+    unpooled = run_slow_tenant_soak(
+        seed=seed, dispatch_pool=False,
+        base_dir=os.path.join(base_dir, "slow_unpooled"))
+
+    def _max_rtt(report):
+        vals = [v for v in report["detail"]
+                ["victim_reply_rtt_ms"].values() if v is not None]
+        return max(vals) if vals else None
+
+    pooled_p95, unpooled_p95 = _max_rtt(pooled), _max_rtt(unpooled)
+    violations: List[str] = []
+    violations.extend("churn: {}".format(v) for v in churn["violations"])
+    violations.extend("share: {}".format(v) for v in share["violations"])
+    violations.extend("slow_tenant(pool=on): {}".format(v)
+                      for v in pooled["violations"])
+    # The unpooled arm's isolation-bound violations are the EXPECTED
+    # demonstration (the A/B's whole point); its lifecycle violations
+    # (lost trials etc.) still count.
+    violations.extend(
+        "slow_tenant(pool=off): {}".format(v)
+        for v in unpooled["violations"] if "isolation bound" not in v)
+    ab_ok = None
+    if pooled_p95 is not None and unpooled_p95 is not None:
+        ab_ok = unpooled_p95 > pooled_p95
+        if not ab_ok:
+            violations.append(
+                "A/B inversion: victim reply latency with pools "
+                "({} ms) is not below the shared-loop arm ({} ms) — the "
+                "isolation win did not materialize".format(
+                    pooled_p95, unpooled_p95))
+    detail = {
+        "churn": churn["detail"],
+        "share": share["detail"],
+        "slow_tenant_ab": {
+            "pooled_victim_reply_ms": pooled_p95,
+            "unpooled_victim_reply_ms": unpooled_p95,
+            "inflation_x": round(unpooled_p95 / pooled_p95, 2)
+            if pooled_p95 and unpooled_p95 else None,
+            "ab_ok": ab_ok,
+            "pooled": pooled["detail"],
+            "unpooled": unpooled["detail"],
+        },
+    }
+    return {"ok": not violations, "violations": violations,
+            "detail": detail, "base_dir": base_dir,
+            "journal": churn["journal"]}

@@ -324,10 +324,21 @@ def render_fleet(status: Dict[str, Any],
             replay["share_error"]))
     if replay.get("preemptions"):
         lines.append("preemptions: {}".format(replay["preemptions"]))
+    if status.get("shed") or replay.get("sheds"):
+        # Load shedding happened: the fleet refused submissions at its
+        # admission bound — say so next to the queue numbers.
+        lines.append("shed submissions: {} (admission bound {})".format(
+            status.get("shed", replay.get("sheds")),
+            status.get("max_queued")))
     qwd = replay.get("queue_wait_ms") or {}
     if qwd:
         lines.append("queue wait: p50 {} ms / p95 {} ms (n={})".format(
             qwd.get("median_ms"), qwd.get("p95_ms"), qwd.get("n")))
+    if replay.get("decisions_per_s"):
+        lines.append("scheduler decisions: {} ({}/s); admission p99 {} "
+                     "ms".format(replay.get("decisions"),
+                                 replay.get("decisions_per_s"),
+                                 replay.get("admission_p99_ms")))
     return "\n".join(lines)
 
 

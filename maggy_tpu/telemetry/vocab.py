@@ -61,6 +61,13 @@ EVENT_KINDS = frozenset({
     "profile_captured",       # device profile + thread dump artifact
                               #   written (path, reason: manual|auto,
                               #   check, partition — telemetry.profiling)
+    "shed",                   # load shed: an admission refused at the
+                              #   fleet's max_queued bound (scope=
+                              #   "admission", fleet journal) or a frame+
+                              #   connection dropped at a tenant's full
+                              #   dispatch queue (scope="rpc", tenant
+                              #   journal) — rpc.SharedServer /
+                              #   fleet.FleetScheduler
 })
 
 #: ``reason=`` on a trial ``requeued`` phase: why it re-entered the
@@ -98,6 +105,12 @@ CHAOS_KINDS = frozenset({
     "kill_runner", "stall_runner", "fake_preemption", "preempt_trial",
     "kill_gang_member",
     "drop_msg", "delay_msg", "sever_conn", "env_write_fail",
+    # Fleet scale soak (fleet/soak.py run_slow_tenant_soak): one tenant's
+    # handlers artificially delayed — the head-of-line-isolation fault.
+    # Injected by the soak harness (not a plan.py fault kind): it wraps
+    # ONE experiment's handle_message, which per-verb plan targeting
+    # cannot express (partition ids overlap across tenants).
+    "slow_tenant",
 })
 
 #: Health-engine event fields (``ev: "health"``).

@@ -64,6 +64,14 @@ def pytest_configure(config):
         "multiplexing concurrent experiments over one runner fleet with "
         "fair share, priorities, and checkpoint-assisted preemption. "
         "Select with -m fleet.")
+    config.addinivalue_line(
+        "markers",
+        "scale: service-scale control-plane tests — SharedServer "
+        "per-tenant dispatch pools, multi-hundred-tenant routing stress, "
+        "batched heartbeats, indexed fleet admission/shedding, and the "
+        "slow-tenant isolation smoke. The fast smokes run in tier-1; "
+        "the big churn soaks live in bench.py --scale. Select with "
+        "-m scale.")
 
 
 @pytest.fixture(autouse=True)

@@ -974,6 +974,7 @@ class TestVerbTimingConformance:
         "REG": {"partition_id": 0},
         "METRIC": {"partition_id": 0, "trial_id": None, "value": None,
                    "step": None, "logs": []},
+        "BATCH": {"partition_id": 0, "beats": []},
         "FINAL": {"partition_id": 0, "trial_id": "t", "value": 1.0,
                   "logs": []},
         "GET": {"partition_id": 0},
