@@ -1026,19 +1026,55 @@ def scale_main():
     arm must show the head-of-line inflation the pools remove. Always a
     CPU-pinned run (the plane under test is platform-independent Python;
     detail.platform records the pin per the ROADMAP comparability note).
-    Exit 1 on any gate violation."""
+    Exit 1 on any gate violation.
+
+    ``--scale --remote`` runs the REMOTE variant instead (ROADMAP item 4
+    remainder — "nothing yet measures hundreds of sockets"): the churn
+    driven by real agent daemon processes over sockets
+    (fleet/soak.py run_remote_scale_soak), recording ``detail.remote``:
+    agent join latency p50/p95, ABIND lease round-trip p50/p95, and
+    churn completion — with ``detail.platform`` pinned the same way for
+    comparability against the in-process rounds."""
     if "MAGGY_TPU_BASE_DIR" not in os.environ:
         os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
     os.environ["JAX_PLATFORMS"] = "cpu"
     for var in _ACCEL_BOOTSTRAP_VARS:
         os.environ.pop(var, None)
+    seed = int(os.environ.get("BENCH_SCALE_SEED", "7"))
+    platform_note = ("cpu pinned (forced; the control plane under test "
+                     "is platform-independent — pinned for cross-round "
+                     "comparability)")
+    t0 = time.time()
+    if "--remote" in sys.argv:
+        from maggy_tpu.fleet.soak import run_remote_scale_soak
+
+        experiments = int(os.environ.get("BENCH_REMOTE_EXPERIMENTS", "40"))
+        agents = int(os.environ.get("BENCH_REMOTE_AGENTS", "4"))
+        runners = int(os.environ.get("BENCH_REMOTE_RUNNERS", "2"))
+        report = run_remote_scale_soak(
+            experiments=experiments, agents=agents, runners=runners,
+            seed=seed)
+        print(json.dumps({
+            "metric": "remote scale soak ({} tenants churned through {} "
+                      "real agent processes over sockets, "
+                      "journal-checked)".format(experiments, agents),
+            "value": report["detail"].get("experiments_per_s") or 0.0,
+            "unit": "experiments_per_s",
+            "detail": {
+                "seed": seed,
+                "wall_s": round(time.time() - t0, 1),
+                "violations": report["violations"],
+                "remote": report["detail"],
+                "platform": platform_note,
+                "journal": report["journal"],
+            },
+        }), flush=True)
+        return 0 if report["ok"] else 1
     from maggy_tpu.fleet.soak import run_scale_soak
 
-    seed = int(os.environ.get("BENCH_SCALE_SEED", "7"))
     experiments = int(os.environ.get("BENCH_SCALE_EXPERIMENTS", "520"))
     runners = int(os.environ.get("BENCH_SCALE_RUNNERS", "8"))
     max_active = int(os.environ.get("BENCH_SCALE_MAX_ACTIVE", "12"))
-    t0 = time.time()
     report = run_scale_soak(experiments=experiments, runners=runners,
                             max_active=max_active, seed=seed)
     churn = report["detail"]["churn"]
@@ -1053,9 +1089,7 @@ def scale_main():
             "wall_s": round(time.time() - t0, 1),
             "violations": report["violations"],
             "scale": report["detail"],
-            "platform": "cpu pinned (forced; the control plane under "
-                        "test is platform-independent — pinned for "
-                        "cross-round comparability)",
+            "platform": platform_note,
             "journal": report["journal"],
         },
     }), flush=True)

@@ -11,6 +11,7 @@ the standard soak runs: a runner killed mid-trial, a false preemption,
     python -m maggy_tpu.chaos --stall                    # health-engine soak
     python -m maggy_tpu.chaos --piggyback                # hand-off soak
     python -m maggy_tpu.chaos --preempt                  # preemption soak
+    python -m maggy_tpu.chaos --agent                    # agent-kill soak
     python -m maggy_tpu.chaos --show-schedule --seed 7   # no experiment
 
 ``--preempt`` runs the graceful-preemption soak: a mid-trial trial is
@@ -92,6 +93,13 @@ def main(argv=None) -> int:
                          "JAX_PLATFORMS=cpu with "
                          "XLA_FLAGS=--xla_force_host_platform_device_"
                          "count=8")
+    ap.add_argument("--agent", action="store_true",
+                    help="run the remote-agent soak: real agent daemon "
+                         "processes (python -m maggy_tpu.fleet agent) "
+                         "serve leases over sockets and one is SIGKILLed "
+                         "mid-lease — the lease must be revoked "
+                         "(reason=agent_lost) and the trial requeued "
+                         "exactly once (invariant 11)")
     ap.add_argument("--show-schedule", action="store_true",
                     help="print the plan's deterministic decision "
                          "expansion and exit (no experiment)")
@@ -113,12 +121,25 @@ def main(argv=None) -> int:
     from maggy_tpu.chaos import harness
     from maggy_tpu.chaos.plan import FaultPlan
 
-    modes = [m for m in ("stall", "piggyback", "preempt", "gang")
+    modes = [m for m in ("stall", "piggyback", "preempt", "gang", "agent")
              if getattr(args, m)]
     if args.plan and modes:
         ap.error("--{} uses a built-in plan; drop --plan".format(modes[0]))
     if len(modes) > 1:
-        ap.error("pick one of --stall / --piggyback / --preempt / --gang")
+        ap.error("pick one of --stall / --piggyback / --preempt / --gang "
+                 "/ --agent")
+    if args.agent:
+        # The agent soak owns its whole topology (a fleet with real
+        # agent subprocesses; the kill is harness-injected, not a
+        # plan.py fault — the plan's pool-level kill cannot reach an
+        # agent in another OS process) — delegate wholesale.
+        from maggy_tpu.fleet.soak import run_agent_soak
+
+        report = run_agent_soak(trials=min(args.trials, 6),
+                                seed=7 if args.seed is None else args.seed,
+                                lock_witness=not args.no_witness)
+        print(json.dumps(report, indent=2, default=str))
+        return 0 if report["ok"] else 1
     if args.plan:
         plan = FaultPlan.load(args.plan)
         # A reproduction run must honor the plan file's embedded seed;

@@ -84,9 +84,15 @@ from maggy_tpu.chaos.plan import FaultPlan, FaultSpec
 #: Fault kinds that imply the affected trial must be requeued. A graceful
 #: preempt_trial requeues through the preempted-FINAL ack (reason
 #: "preempted") — unless the trial outran the STOP and finalized first,
-#: the benign completed_before_detection outcome.
+#: the benign completed_before_detection outcome. ``kill_agent``
+#: (invariant 11, harness-injected by fleet/soak.run_agent_soak) extends
+#: the exactly-once-requeue contract to AGENT scope: a remote agent
+#: SIGKILLed mid-lease can never deliver its FINAL, so the experiment's
+#: slot-reclaim liveness must requeue the trial exactly once — and the
+#: fleet side must revoke the lease (checked from fleet.jsonl by the
+#: soak, not here: this checker sees one experiment's journal).
 _REQUEUE_KINDS = ("kill_runner", "fake_preemption", "preempt_trial",
-                  "kill_gang_member")
+                  "kill_gang_member", "kill_agent")
 
 
 def _obs_scrape_loop(stop_evt, stats: Dict[str, Any]) -> None:
@@ -640,7 +646,10 @@ def check_invariants(events: List[Dict[str, Any]],
                     "slow requeue: {} fault on trial {} took {:.2f}s to "
                     "requeue (bound {:.2f}s)".format(
                         ce["kind"], trial, latency, requeue_bound_s))
-        elif finished and ce["kind"] != "kill_runner":
+        elif finished and ce["kind"] not in ("kill_runner", "kill_agent"):
+            # A killed runner/agent can never deliver the FINAL itself —
+            # a post-kill FINAL without a requeue would mean a duplicate
+            # delivery path, not a benign race.
             rec["outcome"] = "completed_before_detection"
             rec["requeue_latency_s"] = None
         else:

@@ -158,6 +158,8 @@ class ChaosEngine:
         ("delay", seconds) or ("sever",)."""
         verb = msg.get("type")
         pid = msg.get("partition_id")
+        fired = None
+        action = None
         with self._lock:
             for st in self._states:
                 spec = st.spec
@@ -167,14 +169,24 @@ class ChaosEngine:
                     continue
                 if st.should_fire_on_match():
                     st.fired += 1
-                    self._journal(spec, partition=pid, verb=verb,
-                                  occurrence=st.matches)
+                    # Decision under the lock; the journal write happens
+                    # AFTER release — telemetry takes its own locks, and
+                    # holding the engine lock across them is an
+                    # acquisition edge the canonical order need not
+                    # admit.
+                    fired = (spec, st.matches)
                     if spec.kind == "drop_msg":
-                        return ("drop",)
-                    if spec.kind == "delay_msg":
-                        return ("delay", spec.delay_s)
-                    return ("sever",)
-        return None
+                        action = ("drop",)
+                    elif spec.kind == "delay_msg":
+                        action = ("delay", spec.delay_s)
+                    else:
+                        action = ("sever",)
+                    break
+        if fired is not None:
+            spec, occurrence = fired
+            self._journal(spec, partition=pid, verb=verb,
+                          occurrence=occurrence)
+        return action
 
     def on_client_request(self, msg: Dict[str, Any]) -> None:
         """Runner-side cooperation: a condemned partition dies here, a
@@ -208,6 +220,7 @@ class ChaosEngine:
         journal = getattr(self.telemetry, "journal", None)
         if journal is not None and path == getattr(journal, "path", None):
             return
+        fired = None
         with self._lock:
             for st in self._states:
                 spec = st.spec
@@ -218,10 +231,16 @@ class ChaosEngine:
                     continue
                 if st.should_fire_on_match():
                     st.fired += 1
-                    self._journal(spec, path=path, occurrence=st.matches)
-                    raise OSError(
-                        "chaos: injected transient write failure for "
-                        "{}".format(path))
+                    fired = (spec, st.matches)
+                    break
+        if fired is not None:
+            # Journal outside the engine lock (telemetry takes its own
+            # locks), then raise the injected failure.
+            spec, occurrence = fired
+            self._journal(spec, path=path, occurrence=occurrence)
+            raise OSError(
+                "chaos: injected transient write failure for "
+                "{}".format(path))
 
     def on_trial_phase(self, trial_id: str, phase: str,
                        partition: Optional[int]) -> None:

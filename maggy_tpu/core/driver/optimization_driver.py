@@ -135,8 +135,11 @@ class OptimizationDriver(Driver):
             (n for n in sp.names() if sp.get_type(n) == "GANG"),
             None) if sp is not None else None
         binding = getattr(config, "fleet", None)
-        placer_chips = binding.fleet.num_runners if binding is not None \
-            else self.num_executors
+        # Fleet mode: the placer spans thread runners PLUS agent slots —
+        # a remote gang assembles across agent-held fleet runners too.
+        placer_chips = (binding.fleet.num_runners
+                        + getattr(binding.fleet, "max_agents", 0)) \
+            if binding is not None else self.num_executors
         if self._gang_mode and max_gang > placer_chips:
             # The num_executors guard above covers thread pools; in
             # fleet mode the placer spans the FLEET's runners — an
@@ -831,6 +834,30 @@ class OptimizationDriver(Driver):
                 "members": sorted(int(m) for m in members),
                 "leader": int(leader), "mesh": dict(spec.mesh),
                 "strategy": spec.strategy}
+        # REMOTE gang: members registered from other processes (their
+        # REG carried an advertised host_port — fleet agents do, thread
+        # runners never) need a driver-coordinated jax.distributed
+        # rendezvous instead of the runner≈chip-in-one-process
+        # assumption. Stamped only when EVERY member is remote: each
+        # agent is one OS process, so num_processes = len(members) and
+        # every process runs the SPMD program. A MIXED thread+agent gang
+        # must not be stamped — the co-process thread members would be
+        # counted as distinct processes that can never all initialize
+        # (one latch per process), hanging the world forever; it runs
+        # the in-process path instead. Process ids in chip order, leader
+        # = process 0, the leader's advertised address is the
+        # coordinator.
+        res = self.server.reservations
+        coord_by_member = {
+            m: (res.get(m) or {}).get("host_port") for m in members}
+        if all(coord_by_member.get(m) for m in members):
+            ordered = sorted(members, key=self._chip_of)
+            info["rendezvous"] = {
+                "coordinator": coord_by_member[ordered[0]],
+                "num_processes": len(ordered),
+                "process_ids": {str(int(m)): i
+                                for i, m in enumerate(ordered)},
+            }
         with trial.lock:
             trial.info_dict["gang"] = info
         with self._store_lock:
@@ -898,6 +925,14 @@ class OptimizationDriver(Driver):
         with self._store_lock:
             info = self._gangs.get(trial_id)
             return list(info["members"]) if info else []
+
+    def gang_info(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        """Snapshot of an assembled gang's geometry (None if not
+        assembled) — the server's member-serve path reads the
+        ``rendezvous`` block through this."""
+        with self._store_lock:
+            info = self._gangs.get(trial_id)
+            return dict(info) if info else None
 
     def _check_gang_members(self) -> None:
         """Server event-loop scan: a silent member of an assembled gang

@@ -86,7 +86,9 @@ class TrialContext:
             return None
         from maggy_tpu.gang import GangContext
 
-        return GangContext(info)
+        # The member's own partition rides along so a REMOTE gang can
+        # resolve this process's jax.distributed process id.
+        return GangContext({**info, "partition": self.info.get("partition")})
 
     @property
     def needs_fresh_state(self) -> bool:

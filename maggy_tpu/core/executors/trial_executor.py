@@ -93,6 +93,7 @@ class TrialExecutor:
         profile: bool = False,
         ship_prints: bool = False,
         warm_start: bool = True,
+        host_port: Optional[str] = None,
     ):
         self.server_addr = server_addr
         self.secret = secret
@@ -105,6 +106,12 @@ class TrialExecutor:
         self.profile = profile
         self.ship_prints = ship_prints
         self.warm_start = warm_start
+        # Advertised "host:port" this runner can be reached on for
+        # remote-gang rendezvous (a fleet agent's reserved coordinator
+        # address). None for in-process runners — its presence in the
+        # REG record is exactly how the driver tells a remote member
+        # from a thread runner when stamping gang rendezvous info.
+        self.host_port = host_port
 
     def __call__(self, partition_id: int) -> None:
         env = EnvSing.get_instance()
@@ -137,7 +144,8 @@ class TrialExecutor:
         client.runner_stats = stats
         try:
             capacity = os.environ.get("MAGGY_TPU_CAPACITY")
-            client.register(capacity=int(capacity) if capacity else None)
+            client.register(host_port=self.host_port,
+                            capacity=int(capacity) if capacity else None)
             client.start_heartbeat(reporter)
             sig_params = inspect.signature(self.train_fn).parameters
             wants_reporter = "reporter" in sig_params
@@ -162,6 +170,15 @@ class TrialExecutor:
                     reporter.log("resizing to {} chip(s); runner exiting "
                                  "for respawn".format(params["chips"]))
                     break
+                if client.last_info.get("gang_role") == "member":
+                    # Remote-gang MEMBER program: join the
+                    # jax.distributed rendezvous and run the same SPMD
+                    # program as the leader; only the leader reports and
+                    # finalizes, so this path sends no FINAL and loops
+                    # straight back to polling.
+                    self._run_gang_member(trial_id, params, client,
+                                          reporter)
+                    continue
                 trial_dir = "{}/{}".format(exp_dir, trial_id)
                 env.mkdir(trial_dir)
                 env.dump(util.json_dumps_safe(params), trial_dir + "/.hparams.json")
@@ -273,6 +290,39 @@ class TrialExecutor:
                 pass
             client.stop()
 
+
+    def _run_gang_member(self, trial_id: str, params: dict, client,
+                         reporter) -> None:
+        """One remote gang member's side of an SPMD gang trial: every
+        process of the gang must call ``jax.distributed.initialize`` (or
+        the leader's rendezvous hangs) and then run the SAME program so
+        the collectives line up. The member's return value is discarded
+        and it never finalizes — exactly one FINAL per trial, from the
+        leader. Failures are logged, not raised: a broken member makes
+        the leader's mesh fail, and the driver's member-loss/requeue
+        machinery owns that recovery."""
+        import traceback as _tb
+
+        from maggy_tpu.core.executors.context import TrialContext
+
+        trial_dir = "{}/{}".format(self.exp_dir, trial_id)
+        try:
+            ctx = TrialContext(trial_id, trial_dir, self.exp_dir, params,
+                               client.last_info)
+            gang = ctx.gang
+            if gang is None:
+                return
+            gang.ensure_rendezvous()
+            call_params = dict(params)
+            sig_params = inspect.signature(self.train_fn).parameters
+            if "ctx" in sig_params:
+                call_params["ctx"] = ctx
+            if "reporter" in sig_params:
+                call_params["reporter"] = None
+            self.train_fn(**call_params)
+        except Exception:  # noqa: BLE001 - member failure: leader's mesh surfaces it
+            reporter.log("gang member program for {} failed:\n{}".format(
+                trial_id, _tb.format_exc()))
 
     def _run_trial(self, call_params: dict, trial_dir: str, reporter=None):
         """Invoke the user train_fn, optionally under a `jax.profiler`

@@ -290,8 +290,25 @@ class Reservations:
             for pid, rec in self._table.items():
                 if rec.get("gang") == trial_id:
                     rec.pop("gang", None)
+                    rec.pop("gang_served", None)
                     freed.append(pid)
             return freed
+
+    def mark_gang_served(self, partition_id, trial_id: str) -> bool:
+        """One-shot delivery latch for a REMOTE gang's member program:
+        True the first time this held member is served ``trial_id``'s
+        member assignment, False on every retry/re-poll — the member
+        runs the SPMD program exactly once per assembly (the latch
+        clears with the hold in ``release_gang``, so a revoked-and-
+        reassembled gang serves its members again)."""
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            if rec is None or rec.get("gang") != trial_id:
+                return False
+            if rec.get("gang_served") == trial_id:
+                return False
+            rec["gang_served"] = trial_id
+            return True
 
     def gang_members(self, trial_id: str) -> list:
         with self.lock:
@@ -1047,6 +1064,80 @@ class SharedServer:
         self._sel.close()
 
 
+class FleetAgentServer(Server):
+    """The fleet host's control plane for REMOTE AGENTS — the daemon
+    processes (``python -m maggy_tpu.fleet agent``) that turn the
+    in-process fleet into a cross-process, cross-host one. Published on
+    the fleet's ``SharedServer`` under the FLEET secret (the one the
+    fleet ticket carries), so agent traffic shares the same listening
+    socket as every tenant's control plane and re-binding an agent
+    across experiments never needs a new driver-side socket.
+
+    Verbs (the ABIND wire contract, docs/developer.md):
+
+    - ``AJOIN``: an agent declares its capacity (host, chips, process
+      index, optional ``coord_addr`` for remote-gang rendezvous, its OS
+      pid for same-host chaos kills) and is admitted into an agent slot;
+      the reply carries its ``agent`` id plus the poll cadence and
+      liveness bound the fleet will hold it to.
+    - ``ALEASE``: the agent's idle poll (doubles as its idle heartbeat).
+      Replies: ``ABIND`` — a lease: the target experiment's SECRET,
+      partition id, executor config, and the train function's dotted
+      path (``warm_start`` rides along so the agent keeps warm slots
+      across same-family re-leases within its process); ``OK`` — nothing
+      to do; ``AGSTOP`` — the fleet is shutting down, exit.
+    - ``ADONE``: the agent's executor loop returned (GSTOP observed or
+      an error) — the lease closes and the agent returns to the idle
+      pool instead of exiting.
+
+    The handlers delegate to the attached ``fleet.agent.AgentPlane``;
+    msg-key reads stay HERE so the rpcconf checker sees the full wire
+    contract at the handler."""
+
+    def __init__(self, max_agents: int, secret: Optional[str] = None):
+        # The plane (maggy_tpu.fleet.agent.AgentPlane), attached by the
+        # fleet. None rejects every agent verb.
+        self.agent_plane = None
+        super().__init__(max_agents, secret)
+
+    def attach_plane(self, plane) -> None:
+        self.agent_plane = plane
+
+    def _register_handlers(self) -> None:
+        super()._register_handlers()
+        self._handlers.update(
+            AJOIN=self._ajoin,
+            ALEASE=self._alease,
+            ADONE=self._adone,
+        )
+
+    def _ajoin(self, msg):
+        plane = self.agent_plane
+        if plane is None:
+            return {"type": "ERR",
+                    "error": "this fleet does not accept remote agents"}
+        return plane.agent_join(
+            host=msg.get("host"), chips=msg.get("chips"),
+            process_index=msg.get("process_index"),
+            coord_addr=msg.get("coord_addr"), os_pid=msg.get("os_pid"),
+            agent=msg.get("agent"))
+
+    def _alease(self, msg):
+        plane = self.agent_plane
+        if plane is None:
+            return {"type": "ERR",
+                    "error": "this fleet does not accept remote agents"}
+        return plane.agent_lease(agent=msg.get("agent"))
+
+    def _adone(self, msg):
+        plane = self.agent_plane
+        if plane is None:
+            return {"type": "ERR",
+                    "error": "this fleet does not accept remote agents"}
+        return plane.agent_done(agent=msg.get("agent"),
+                                error=msg.get("error"))
+
+
 class OptimizationServer(Server):
     """HPO/ablation message semantics (reference `rpc.py:295-388`).
 
@@ -1278,6 +1369,9 @@ class OptimizationServer(Server):
         reply = self._serve_assigned(msg["partition_id"])
         if reply is not None:
             return reply
+        member = self._serve_gang_member(pid)
+        if member is not None:
+            return member
         if self.driver.experiment_done:
             self.reservations.mark_released(msg["partition_id"])
             return {"type": "GSTOP"}
@@ -1289,6 +1383,44 @@ class OptimizationServer(Server):
             self.reservations.mark_released(msg["partition_id"])
             return {"type": "RESIZE", "chips": resize}
         return {"type": "OK", "trial_id": None}
+
+    def _serve_gang_member(self, partition_id):
+        """REMOTE-gang member delivery: a gang-held member whose gang
+        carries a ``rendezvous`` block lives in ANOTHER process, so it
+        must run the SPMD program itself (every process of a
+        jax.distributed world runs the same program, or the leader's
+        collectives hang). Serve it the gang trial ONCE per assembly,
+        flagged ``gang_role="member"`` — the executor joins the
+        rendezvous, runs the program, discards the result, and never
+        finalizes (exactly one FINAL, from the leader). In-process gangs
+        (no rendezvous) never reach this: their members keep idling, the
+        leader computes over all local chips as before."""
+        res = self.reservations
+        tid = res.gang_of(partition_id)
+        if tid is None or res.get_assigned_trial(partition_id) == tid:
+            return None
+        gang_info = getattr(self.driver, "gang_info", None)
+        info_g = gang_info(tid) if gang_info is not None else None
+        if not info_g or not info_g.get("rendezvous"):
+            return None
+        if int(partition_id) == int(info_g.get("leader", -1)):
+            # Assembly window: _gangs is stored a few statements before
+            # assign_trial(leader) — a leader GET landing in between
+            # must wait for its LEADER assignment, not burn the member
+            # latch and run the program twice.
+            return None
+        if not res.mark_gang_served(partition_id, tid):
+            return None
+        trial = self.driver.get_trial(tid)
+        if trial is None:
+            return None
+        with trial.lock:
+            info = dict(trial.info_dict)
+        info["partition"] = int(partition_id)
+        info["gang_role"] = "member"
+        return {"type": "TRIAL", "trial_id": trial.trial_id,
+                "params": trial.params, "info": info,
+                "span": info.get("span")}
 
     def _log(self, msg):
         return {"type": "LOG", **self.driver.progress_snapshot()}

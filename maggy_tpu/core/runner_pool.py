@@ -520,6 +520,12 @@ class RemoteRunnerPool(RunnerPool):
     (``python -m maggy_tpu.runner``) on other machines — TPU VMs of a pod
     slice — that dial the driver's control plane and JOIN.
 
+    Scope note: these agents belong to ONE experiment and exit with it.
+    For a PERSISTENT cross-process fleet that outlives any experiment —
+    agents leased, preempted, and re-bound across experiments — use
+    fleet agents instead (``maggy_tpu/fleet/agent.py``, ``python -m
+    maggy_tpu.fleet agent``): same ticket-and-JOIN shape, fleet-scoped.
+
     The pool spawns nothing. It publishes a join ticket (advertised address
     + shared secret) to the experiment directory — typically a shared
     filesystem or GCS, the same discovery role as the reference POSTing the

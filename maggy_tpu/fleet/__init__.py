@@ -17,6 +17,8 @@ submissions for cross-process use); live view:
 ``python -m maggy_tpu.monitor --fleet <home_dir>``.
 """
 
+from maggy_tpu.fleet.agent import (AGENT_TICKET_NAME, AgentPlane,
+                                   FleetAgent, read_fleet_ticket)
 from maggy_tpu.fleet.scheduler import (FLEET_JOURNAL_NAME, ExperimentEntry,
                                        Fleet, FleetBinding, FleetLeasedPool,
                                        FleetPolicy, FleetSaturated,
@@ -28,4 +30,5 @@ __all__ = [
     "FleetBinding", "FleetLeasedPool", "FleetSubmission",
     "ExperimentEntry", "FLEET_JOURNAL_NAME", "priority_rank",
     "replay_fleet_journal",
+    "AgentPlane", "FleetAgent", "AGENT_TICKET_NAME", "read_fleet_ticket",
 ]

@@ -2,10 +2,17 @@
 
     start   host a fleet in this process and serve submissions from a
             spec file and/or the fleet home's ``queue/`` spool directory
+            (``--max-agents N`` additionally opens the remote-agent
+            plane and writes ``<home>/agent_ticket.json``)
+    agent   run a REMOTE AGENT daemon: read the fleet ticket, JOIN, and
+            serve leases until the fleet releases us — start one per
+            process/k8s pod/TPU-VM worker, anywhere that can reach the
+            fleet's socket
     submit  drop a submission JSON into a running fleet's spool
     status  print the fleet's status.json + journal-replayed shares
     soak    run the built-in two-experiment preemption soak (invariants
-            checked; exit 1 on violation)
+            checked; exit 1 on violation); ``--agent`` runs the
+            agent-kill soak (invariant 11) with real agent processes
 
 A submission spec names a module-level train function and the
 OptimizationConfig fields (searchspace as ``{name: [TYPE, range]}``):
@@ -143,14 +150,20 @@ def _cmd_start(args) -> int:
     fleet = Fleet(runners=args.runners, name=args.name,
                   home_dir=args.home, max_active=args.max_active,
                   max_queued=args.max_queued,
-                  preempt_grace_s=args.preempt_grace)
+                  preempt_grace_s=args.preempt_grace,
+                  max_agents=args.max_agents,
+                  bind_host=args.bind_host)
     spool = fleet.home_dir + "/queue"
     env.mkdir(spool)
     handles: Dict[str, Any] = {}
     seen: set = set()
     with fleet:
-        print("fleet {!r}: {} runner(s), home {}".format(
-            fleet.name, fleet.num_runners, fleet.home_dir), flush=True)
+        print("fleet {!r}: {} runner(s), {} agent slot(s), home {}".format(
+            fleet.name, fleet.num_runners, fleet.max_agents,
+            fleet.home_dir), flush=True)
+        if fleet.agent_plane is not None:
+            print("agent ticket: {}/agent_ticket.json".format(
+                fleet.home_dir), flush=True)
         for spec_path in args.spec or []:
             with open(spec_path) as f:
                 loaded = json.load(f)
@@ -204,10 +217,19 @@ def _cmd_status(args) -> int:
     return 0
 
 
-def _cmd_soak(args) -> int:
-    from maggy_tpu.fleet.soak import run_fleet_soak, run_slow_tenant_soak
+def _cmd_agent(args) -> int:
+    from maggy_tpu.fleet.agent import agent_main
 
-    if args.slow_tenant:
+    return agent_main(args)
+
+
+def _cmd_soak(args) -> int:
+    from maggy_tpu.fleet.soak import (run_agent_soak, run_fleet_soak,
+                                      run_slow_tenant_soak)
+
+    if args.agent:
+        report = run_agent_soak(seed=args.seed, lock_witness=True)
+    elif args.slow_tenant:
         # Witness on by default, like the chaos CLI's soaks: the
         # isolation run doubles as a dynamic lock-order check.
         report = run_slow_tenant_soak(
@@ -253,6 +275,57 @@ def main(argv=None) -> int:
     ps.add_argument("--idle-exit", type=float, default=None,
                     help="exit after this many idle seconds (no pending "
                          "experiments, empty spool); default: run forever")
+    ps.add_argument("--max-agents", type=int, default=0,
+                    help="remote-agent slots: >0 opens the agent plane "
+                         "and writes <home>/agent_ticket.json for "
+                         "`python -m maggy_tpu.fleet agent` daemons "
+                         "(default 0 = in-process only)")
+    ps.add_argument("--bind-host", default="127.0.0.1",
+                    help="address the shared listener binds (default "
+                         "loopback; set 0.0.0.0 for cross-host agents — "
+                         "the ticket then advertises this host's IP)")
+
+    pa = sub.add_parser(
+        "agent", help="run a remote fleet-agent daemon")
+    pa.add_argument("--ticket",
+                    help="path to the fleet's agent_ticket.json "
+                         "(written by `start --max-agents`)")
+    pa.add_argument("--wait-ticket", type=float, default=30.0,
+                    help="seconds to wait for the ticket file to appear")
+    pa.add_argument("--fleet-addr",
+                    help="fleet control-plane address HOST:PORT "
+                         "(alternative to --ticket)")
+    pa.add_argument("--secret", help="fleet secret (hex)")
+    pa.add_argument("--secret-file", help="file containing the fleet "
+                                          "secret")
+    pa.add_argument("--chips", type=int, default=1,
+                    help="chip capacity this agent declares (and pins "
+                         "to, with --pin)")
+    pa.add_argument("--process-index", type=int, default=0,
+                    help="this agent's index among the agents on this "
+                         "host (selects its chip subset with --pin)")
+    pa.add_argument("--pin", action="store_true",
+                    help="pin this process to its disjoint TPU chip "
+                         "subset (TPU_VISIBLE_CHIPS) before backend "
+                         "init — one agent per subset per pod VM")
+    pa.add_argument("--advertise-host", default="127.0.0.1",
+                    help="host other gang members can reach this agent "
+                         "on (the jax.distributed coordinator address "
+                         "for remote gangs)")
+    pa.add_argument("--obs-port", type=int, default=None,
+                    help="per-agent observability: serve /healthz + "
+                         "/metrics on this port (0 = ephemeral; default "
+                         "off) — the k8s liveness probe")
+    pa.add_argument("--home", help="agent scratch dir (obs journal); "
+                                   "default: a tempdir")
+    pa.add_argument("--profile", action="store_true",
+                    help="capture a jax.profiler trace per trial")
+    pa.add_argument("--max-leases", type=int, default=None,
+                    help="exit after serving this many leases (batch "
+                         "jobs/tests; default: run until AGSTOP)")
+    pa.add_argument("--idle-exit", type=float, default=None,
+                    help="exit after this many idle seconds with no "
+                         "lease (default: run forever)")
 
     pq = sub.add_parser("submit", help="queue a spec into a fleet's spool")
     pq.add_argument("--home", required=True)
@@ -265,6 +338,13 @@ def main(argv=None) -> int:
     pk = sub.add_parser("soak", help="run the built-in preemption soak")
     pk.add_argument("--runners", type=int, default=2)
     pk.add_argument("--seed", type=int, default=7)
+    pk.add_argument("--agent", action="store_true",
+                    help="run the agent-kill soak instead: real agent "
+                         "subprocesses serve leases over sockets, one "
+                         "is SIGKILLed mid-lease, and invariant 11 "
+                         "(lease revoked, trial requeued exactly once) "
+                         "is checked from the journals (run under the "
+                         "lock-order witness)")
     pk.add_argument("--slow-tenant", action="store_true",
                     help="run the slow-tenant isolation soak instead: one "
                          "tenant's handlers artificially delayed, other "
@@ -277,8 +357,9 @@ def main(argv=None) -> int:
                          "invariant is expected to FAIL in this mode")
 
     args = p.parse_args(argv)
-    return {"start": _cmd_start, "submit": _cmd_submit,
-            "status": _cmd_status, "soak": _cmd_soak}[args.command](args)
+    return {"start": _cmd_start, "agent": _cmd_agent,
+            "submit": _cmd_submit, "status": _cmd_status,
+            "soak": _cmd_soak}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -138,6 +138,16 @@ class ExperimentEntry:  # guarded-by: FleetScheduler._lock
         self.submitted_t = time.time()
         self.admitted_t: Optional[float] = None
         self.first_lease_t: Optional[float] = None
+        # Dotted module:function path of the submission's train fn (set
+        # at submit when derivable) — what an ABIND lease ships to a
+        # REMOTE agent. None = agent-ineligible (closure/lambda/__main__
+        # train fns can't be named on the wire): only thread runners
+        # serve this experiment.
+        self.train_fn_path: Optional[str] = None
+        # Built at activate(): the executor config an agent lease
+        # carries (secret, hb_interval, exp_dir, ..., train_fn). None =
+        # agent-ineligible.
+        self.agent_info: Optional[Dict[str, Any]] = None
         # Bound at activate() (the driver exists by then):
         self.driver = None
         self.executor_fn: Optional[Callable[[int], None]] = None
@@ -200,8 +210,15 @@ class FleetScheduler:
     def __init__(self, fleet_size: int, telemetry=None,
                  max_active: Optional[int] = None,
                  preempt_grace_s: float = 1.0,
-                 max_queued: Optional[int] = None):
+                 max_queued: Optional[int] = None,
+                 max_size: Optional[int] = None):
         self.fleet_size = int(fleet_size)
+        # Upper bound the fleet can GROW to as remote agents join
+        # (thread runners + agent slots). Gang feasibility checks
+        # compare against this, not the current size — a gang that fits
+        # once the agents arrive must park, not fail.
+        self.max_size = int(max_size) if max_size is not None \
+            else self.fleet_size
         self.telemetry = telemetry
         self.max_active = max_active
         self.max_queued = max_queued
@@ -237,6 +254,13 @@ class FleetScheduler:
         # assemble an N-chip contiguous mesh slice out of fleet runners
         # without fair share starving the gang at N-1 members forever.
         self._gang_blocks: Dict[str, List[int]] = {}  # guarded-by: _lock
+        # Remote-agent runner slots (maggy_tpu.fleet.agent): indexes at
+        # and above the thread-fleet size, allocated as agents join.
+        # Vacant slots (their agent left/died) stay allocated — indexes
+        # are identities in the journal — but stop counting toward
+        # fair-share capacity until the next joiner reuses them.
+        self._agent_slots: set = set()  # guarded-by: _lock
+        self._vacant_agent_slots: set = set()  # guarded-by: _lock
         self._seq = itertools.count()
         self.stopped = False
 
@@ -302,9 +326,11 @@ class FleetScheduler:
         """The experiment's driver is up: bind it so leasing can begin.
         ``slots`` is the driver's partition-id range (its server's
         num_executors)."""
+        agent_info = self._build_agent_info(entry, driver)
         with self._lock:
             entry.driver = driver
             entry.executor_fn = executor_fn
+            entry.agent_info = agent_info
             entry.slots = int(slots)
             entry.free_pids = set(range(int(slots)))
             entry.exp_dir = getattr(driver, "exp_dir", None)
@@ -312,6 +338,33 @@ class FleetScheduler:
             self._event("fleet_experiment", exp=entry.name, phase="start",
                         slots=entry.slots, exp_dir=entry.exp_dir)
             self._wake.notify_all()
+
+    @staticmethod
+    def _build_agent_info(entry: ExperimentEntry,
+                          driver) -> Optional[Dict[str, Any]]:
+        """The executor config an ABIND lease ships to a remote agent —
+        the fleet generalization of the per-experiment JOIN reply. None
+        when the experiment can't be served remotely: no wire-nameable
+        train fn, or a driver without the trial-executor loop shape
+        (only HPO/ablation drivers lease agents today)."""
+        if entry.train_fn_path is None:
+            return None
+        okey = getattr(driver, "optimization_key", None)
+        if okey is None:
+            return None
+        return {
+            "secret": driver.secret_for_clients(),
+            "hb_interval": driver.hb_interval,
+            "exp_dir": driver.exp_dir,
+            "optimization_key": okey,
+            "trial_type": "optimization",
+            # Honest warm-state note: warm slots are PER-PROCESS — the
+            # flag keeps them across same-family re-leases within one
+            # agent process; the persistent XLA cache is the only
+            # cross-process reuse (docs/user.md).
+            "warm_start": bool(getattr(driver.config, "warm_start", True)),
+            "train_fn": entry.train_fn_path,
+        }
 
     def wait_admitted(self, entry: ExperimentEntry,
                       timeout: Optional[float] = None) -> bool:
@@ -366,6 +419,41 @@ class FleetScheduler:
             self.stopped = True
             self._wake.notify_all()
 
+    # ---------------------------------------------------------- agent slots
+
+    def agent_slot_attach(self) -> int:
+        """Allocate a runner index for a joining remote agent: reuse the
+        lowest vacant agent slot, else grow the fleet by one. The index
+        behaves exactly like a thread runner's in every lease path."""
+        with self._lock:
+            if self._vacant_agent_slots:
+                idx = min(self._vacant_agent_slots)
+                self._vacant_agent_slots.discard(idx)
+            else:
+                idx = self.fleet_size
+                self.fleet_size += 1
+                self._agent_slots.add(idx)
+            self._targets_cache = None
+            self._wake.notify_all()
+            return idx
+
+    def agent_slot_detach(self, runner_idx: int) -> None:
+        """The slot's agent left or was lost: the index stops counting
+        toward fair-share capacity until the next joiner reuses it."""
+        with self._lock:
+            if runner_idx in self._agent_slots:
+                self._vacant_agent_slots.add(runner_idx)
+                self._targets_cache = None
+                self._wake.notify_all()
+
+    def is_agent_slot(self, runner_idx: int) -> bool:
+        with self._lock:
+            return runner_idx in self._agent_slots
+
+    def live_agent_slots(self) -> int:
+        with self._lock:
+            return len(self._agent_slots) - len(self._vacant_agent_slots)
+
     # -------------------------------------------------------------- targets
 
     # locked-by: _lock
@@ -396,7 +484,9 @@ class FleetScheduler:
                   if e.ready() and not (e.driver is not None
                                         and e.driver.experiment_done)]
         targets = {e.name: 0 for e in active}
-        remaining = self.fleet_size
+        # Vacant agent slots hold no runner: capacity they'd promise can
+        # never be leased, so the waterfill excludes them.
+        remaining = self.fleet_size - len(self._vacant_agent_slots)
         # Guaranteed minimums, strictly by priority then submit order.
         for e in sorted(active, key=lambda e: (e.policy.rank, e.seq)):
             give = min(e.policy.min_runners, e.effective_max(self.fleet_size),
@@ -458,12 +548,15 @@ class FleetScheduler:
         from maggy_tpu.gang import aligned_windows
 
         size = int(size)
-        if size > self.fleet_size:
+        if size > self.max_size:
             # Clamping would latch a too-small block and hang the
             # experiment's gang demand forever — fail loudly instead.
+            # Compared against the GROWN-TO bound: a fleet still waiting
+            # for its agents returns None below (no window yet) and the
+            # caller retries.
             raise ValueError(
                 "a gang of {} runners can never assemble on a {}-runner "
-                "fleet".format(size, self.fleet_size))
+                "fleet".format(size, self.max_size))
         with self._lock:
             existing = self._gang_blocks.get(entry.name)
             if existing is not None:
@@ -528,8 +621,11 @@ class FleetScheduler:
         # fair-sharing the Nth would deadlock the gang). If the owner
         # can't take it right now, the runner waits: binding it
         # elsewhere would re-busy the block instead of draining it.
+        is_agent = runner_idx in self._agent_slots
         owner = self._gang_owner_locked(runner_idx)
         if owner is not None:
+            if is_agent and owner.agent_info is None:
+                return None
             if owner.wants_runners() and \
                     owner.allocated() < owner.effective_max(self.fleet_size):
                 return owner
@@ -540,6 +636,10 @@ class FleetScheduler:
         best_key = None
         for e in self._active.values():
             if not e.wants_runners():
+                continue
+            if is_agent and e.agent_info is None:
+                # A remote agent can only serve experiments whose train
+                # fn is wire-nameable (ABIND ships a dotted path).
                 continue
             if e.allocated() >= e.effective_max(self.fleet_size):
                 continue
@@ -563,8 +663,11 @@ class FleetScheduler:
         return entry, pid
 
     def release_binding(self, runner_idx: int, entry: ExperimentEntry,
-                        pid: int, error: Optional[BaseException] = None
-                        ) -> None:
+                        pid: int, error: Optional[BaseException] = None,
+                        reason: Optional[str] = None) -> None:
+        """``reason`` overrides the journaled lease-end reason (vocab
+        LEASE_END_REASONS) — the agent plane passes ``agent_lost`` when
+        it revokes a lease whose agent went silent mid-lease."""
         with self._lock:
             held = entry.open_leases.pop(runner_idx, None)
             if held is not None:
@@ -575,7 +678,8 @@ class FleetScheduler:
                 entry.failures.append(error)
             self._event("lease", exp=entry.name, runner=runner_idx, pid=pid,
                         phase="end",
-                        reason="error" if error is not None else "released",
+                        reason=reason or (
+                            "error" if error is not None else "released"),
                         duration_s=round(time.monotonic() - held[1], 3)
                         if held is not None else None)
             self._wake.notify_all()
@@ -700,6 +804,8 @@ class FleetScheduler:
             entries = sorted(self._entries.values(), key=lambda e: e.seq)
             return {
                 "fleet_size": self.fleet_size,
+                "agent_slots": len(self._agent_slots)
+                - len(self._vacant_agent_slots),
                 "queue_depth": self._queued_count,
                 "active": len(self._active),
                 "shed": self.shed_count,
@@ -822,11 +928,18 @@ class FleetLeasedPool(RunnerPool):
         return list(entry.failures)
 
     def kill_worker(self, partition_id: int) -> bool:
-        runner = self.binding.fleet.scheduler.runner_for(
-            self.binding.entry, partition_id)
+        fleet = self.binding.fleet
+        runner = fleet.scheduler.runner_for(self.binding.entry,
+                                            partition_id)
         if runner is None:
             return False
-        return self.binding.fleet.pool.kill_worker(runner)
+        if fleet.scheduler.is_agent_slot(runner):
+            # The lease is held by a REMOTE agent: route the kill to the
+            # agent plane (same-host SIGKILL — the chaos/soak path).
+            plane = fleet.agent_plane
+            return plane is not None \
+                and plane.kill_agent_by_runner(runner)
+        return fleet.pool.kill_worker(runner)
 
     def chip_of(self, partition_id: int) -> Optional[int]:
         """The fleet runner index (runner ≈ chip) this partition is
@@ -857,12 +970,18 @@ class Fleet:
                  preempt_grace_s: float = 1.0, telemetry: bool = True,
                  obs_port: Optional[int] = None,
                  obs_host: str = "127.0.0.1",
-                 dispatch_pool: Optional[bool] = None):
+                 dispatch_pool: Optional[bool] = None,
+                 max_agents: int = 0,
+                 bind_host: str = "127.0.0.1",
+                 agent_liveness_s: Optional[float] = None):
         if pool != "thread":
             raise ValueError(
                 "fleet pools are in-process ('thread'): experiments are "
                 "submitted as live callables and scheduler bindings cross "
-                "no process boundary (got pool={!r})".format(pool))
+                "no process boundary (got pool={!r}). Cross-process "
+                "capacity comes from REMOTE AGENTS instead: pass "
+                "max_agents=N and start agents with `python -m "
+                "maggy_tpu.fleet agent --ticket <home>/agent_ticket.json`")
         from maggy_tpu.core.environment import EnvSing
         from maggy_tpu.core.rpc import SharedServer
         from maggy_tpu.telemetry import Telemetry
@@ -880,11 +999,20 @@ class Fleet:
         self.scheduler = FleetScheduler(
             self.num_runners, telemetry=self.telemetry,
             max_active=max_active, max_queued=max_queued,
-            preempt_grace_s=preempt_grace_s)
+            preempt_grace_s=preempt_grace_s,
+            max_size=self.num_runners + int(max_agents))
         # dispatch_pool=None -> per-tenant handler pools on (the
         # default; MAGGY_TPU_SHARED_DISPATCH_POOL=0 or False restores
         # handlers-on-the-loop for A/B measurement).
         self.shared_server = SharedServer(dispatch_pool=dispatch_pool)
+        # Remote agents (maggy_tpu.fleet.agent): max_agents > 0 opens
+        # the agent plane at start() — a FleetAgentServer on the shared
+        # listener plus the fleet ticket in home_dir. 0 (default) keeps
+        # the fleet purely in-process, bit-for-bit the old behavior.
+        self.max_agents = int(max_agents)
+        self.bind_host = bind_host
+        self._agent_liveness_s = agent_liveness_s
+        self.agent_plane = None
         self._pool_thread: Optional[threading.Thread] = None
         self._tick_thread: Optional[threading.Thread] = None
         self._started = False
@@ -934,6 +1062,14 @@ class Fleet:
         self._tick_thread = threading.Thread(
             target=self._tick_loop, daemon=True, name="fleet-tick")
         self._tick_thread.start()
+        if self.max_agents > 0:
+            from maggy_tpu.fleet.agent import DEFAULT_LIVENESS_S, AgentPlane
+
+            self.agent_plane = AgentPlane(
+                self, max_agents=self.max_agents,
+                liveness_s=self._agent_liveness_s
+                if self._agent_liveness_s is not None
+                else DEFAULT_LIVENESS_S).start()
         self._dump_status()
         return self
 
@@ -979,6 +1115,10 @@ class Fleet:
             for t in subs:
                 t.join(timeout=max(0.1, deadline - time.monotonic()))
         self.scheduler.stop()
+        if self.agent_plane is not None:
+            # After scheduler.stop(): proxies wake from next_binding,
+            # and agents' next ALEASE polls read AGSTOP.
+            self.agent_plane.stop()
         for t in (self._pool_thread, self._tick_thread):
             if t is not None:
                 t.join(timeout=5)
@@ -1012,6 +1152,9 @@ class Fleet:
                              min_runners=min_runners,
                              max_runners=max_runners)
         base = name or getattr(config, "name", "experiment")
+        from maggy_tpu.fleet.agent import train_fn_path
+
+        fn_path = train_fn_path(train_fn)
         with self._lock:
             if self._stopped:
                 raise RuntimeError("fleet {!r} is shut down".format(self.name))
@@ -1019,6 +1162,10 @@ class Fleet:
             while sub_name in self._submissions:
                 sub_name = "{}-{}".format(base, next(self._sub_seq))
             entry = self.scheduler.submit(sub_name, policy)
+            # Wire-nameable train fns make the experiment leasable to
+            # REMOTE agents (ABIND ships the dotted path); closures and
+            # lambdas keep it on thread runners only.
+            entry.train_fn_path = fn_path
             handle = FleetSubmission(sub_name, entry)
             self._submissions[sub_name] = handle
             # Prune finished submission threads so a long-lived host
@@ -1057,7 +1204,10 @@ class Fleet:
                     "fleet {!r} stopped before experiment {!r} was "
                     "admitted".format(self.name, entry.name))
             sub = exp_mod._begin_run(config, self.env, exclusive=False)
-            slots = entry.effective_max(self.num_runners)
+            # Partition-id range: thread runners PLUS agent slots — an
+            # agent-backed fleet must be able to lease more runners to
+            # one experiment than the host process has threads.
+            slots = entry.effective_max(self.num_runners + self.max_agents)
             replacements = dict(fleet=FleetBinding(self, entry),
                                 num_workers=max(1, slots))
             if self._obs_port is not None \
@@ -1091,9 +1241,13 @@ class Fleet:
 
     def status(self) -> Dict[str, Any]:
         snap = self.scheduler.snapshot()
+        plane = self.agent_plane
         return {"t": time.time(), "name": self.name,
                 "runners": self.num_runners, "pool": "thread",
-                "stopped": self._stopped, **snap}
+                "stopped": self._stopped,
+                "max_agents": self.max_agents,
+                "agents": plane.snapshot() if plane is not None else [],
+                **snap}
 
     def _dump_status(self) -> None:
         try:
@@ -1131,6 +1285,14 @@ def replay_fleet_journal(path: str, env=None,
     decisions = 0
     first_t: Optional[float] = None
     last_t = 0.0
+    # Remote-agent lanes: per-agent lifecycle counts plus the ABIND
+    # delivery latency (lease set -> ALEASE poll pickup) distribution —
+    # the "lease round-trip" number bench.py --scale --remote reports.
+    agent_joins = 0
+    agent_losses = 0
+    agent_leases: Dict[str, int] = {}
+    abind_ms: List[float] = []
+    agent_lost_leases = 0
 
     def exp(name: str) -> Dict[str, Any]:
         return exps.setdefault(name, {
@@ -1172,6 +1334,19 @@ def replay_fleet_journal(path: str, env=None,
                 t0 = e["open"].pop(key, None)
                 if t0 is not None and t is not None:
                     e["leases"].append((t0, t))
+                if ev.get("reason") == "agent_lost":
+                    agent_lost_leases += 1
+        elif kind == "agent":
+            phase = ev.get("phase")
+            if phase == "join":
+                agent_joins += 1
+            elif phase == "lost":
+                agent_losses += 1
+            elif phase == "lease":
+                aid = str(ev.get("agent"))
+                agent_leases[aid] = agent_leases.get(aid, 0) + 1
+                if ev.get("abind_ms") is not None:
+                    abind_ms.append(float(ev["abind_ms"]))
         elif kind == "preempt":
             preempts += 1
             exp(ev["exp"])["preemptions"] += 1
@@ -1238,6 +1413,15 @@ def replay_fleet_journal(path: str, env=None,
         "experiments": out_exps,
         "preemptions": preempts,
         "sheds": sheds,
+        # Remote-agent plane (empty/zero for purely in-process fleets).
+        "agents": {
+            "joins": agent_joins,
+            "losses": agent_losses,
+            "lost_leases": agent_lost_leases,
+            "leases": sum(agent_leases.values()),
+            "per_agent_leases": dict(sorted(agent_leases.items())),
+            "abind_ms": _dist_stats(abind_ms),
+        },
         "share": share,
         "expected_share": expected,
         "share_error": share_error,

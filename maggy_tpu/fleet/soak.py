@@ -610,6 +610,331 @@ def run_slow_tenant_soak(runners: int = 3, victims: int = 2,
             "witness": witness_block, "base_dir": base_dir}
 
 
+# --------------------------------------------------------------- agent soaks
+
+
+def agent_train_fn(lr, units, reporter=None, ctx=None):
+    """Remote-agent soak trial: ~1.5 s of broadcasting wall so a
+    SIGKILL reliably lands MID-lease (the invariant-11 window), cheap
+    enough that a soak of a dozen trials stays fast. Module-level so an
+    ABIND lease can name it (``maggy_tpu.fleet.soak:agent_train_fn``)."""
+    import time as _time
+
+    value = 1.0 / (1.0 + abs(lr - 0.1) + units / 1e4)
+    for step in range(30):
+        if reporter is not None:
+            reporter.broadcast(value * (step + 1), step=step)
+        _time.sleep(0.05)
+    return {"metric": value}
+
+
+def spawn_agent_process(ticket_path: str, obs_port: Optional[int] = None,
+                        log_path: Optional[str] = None,
+                        idle_exit_s: Optional[float] = None):
+    """Start one REAL agent daemon (``python -m maggy_tpu.fleet agent``)
+    as a separate OS process, CPU-pinned — the substrate the agent soaks
+    and ``bench.py --scale --remote`` measure. Returns the Popen."""
+    import subprocess
+    import sys
+
+    cmd = [sys.executable, "-m", "maggy_tpu.fleet", "agent",
+           "--ticket", ticket_path, "--wait-ticket", "60"]
+    if obs_port is not None:
+        cmd += ["--obs-port", str(obs_port)]
+    if idle_exit_s is not None:
+        cmd += ["--idle-exit", str(idle_exit_s)]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = open(log_path, "ab") if log_path else subprocess.DEVNULL
+    return subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT
+                            if log_path else subprocess.DEVNULL, env=env)
+
+
+def run_agent_soak(agents: int = 2, trials: int = 6, seed: int = 7,
+                   base_dir: Optional[str] = None,
+                   result_timeout_s: float = 240.0,
+                   lease_timeout_s: float = 120.0,
+                   lock_witness: Optional[bool] = None) -> Dict[str, Any]:
+    """Chaos invariant 11: REAL agent processes serve leases over
+    sockets; one is SIGKILLed mid-lease. The experiment's slot-reclaim
+    liveness must requeue the killed trial EXACTLY once (the invariant-
+    6/7/8 machinery extended to agent scope via the ``kill_agent`` chaos
+    kind), the fleet must revoke the lease (``lease`` end
+    ``reason=agent_lost`` + ``agent`` phase ``lost`` in fleet.jsonl),
+    and the experiment must still complete its full schedule on the
+    survivors (the thread runner + the remaining agent). Runs under the
+    lock-order witness like every chaos soak."""
+    import signal
+
+    from maggy_tpu import experiment
+    from maggy_tpu.analysis import witness as _witness
+    from maggy_tpu.chaos.harness import check_invariants
+    from maggy_tpu.telemetry import JOURNAL_NAME, read_events
+
+    wit = None
+    wit_installed_here = False
+    wit_pre_violations = 0
+    if lock_witness or (lock_witness is None and _witness.enabled_by_env()):
+        wit_installed_here = _witness.active_witness() is None
+        wit = _witness.install()
+        wit_pre_violations = len(wit.violations)
+
+    base_dir = base_dir or tempfile.mkdtemp(prefix="maggy_agent_soak_")
+    t0 = time.time()
+    fleet = Fleet(runners=1, max_agents=agents,
+                  home_dir=os.path.join(base_dir, "fleet"),
+                  agent_liveness_s=3.0, preempt_grace_s=5.0)
+    procs = []
+    killed = {"agent": None, "trial": None, "partition": None}
+    violations: List[str] = []
+    try:
+        with fleet:
+            ticket = os.path.join(fleet.home_dir, "agent_ticket.json")
+            for i in range(agents):
+                procs.append(spawn_agent_process(
+                    ticket, log_path=os.path.join(
+                        base_dir, "agent{}.log".format(i))))
+            sub = experiment.lagom_submit(
+                agent_train_fn,
+                _scale_config("agentexp", trials, base_dir, seed,
+                              hb_interval=0.05, telemetry=True),
+                fleet=fleet, block=False, name="agentexp")
+            # Wait for a LEASED agent whose partition holds a running
+            # trial — the mid-lease window the kill must land in.
+            deadline = time.monotonic() + lease_timeout_s
+            plane = fleet.agent_plane
+            while time.monotonic() < deadline and killed["agent"] is None:
+                drv = sub.entry.driver
+                if drv is None:
+                    time.sleep(0.05)
+                    continue
+                for rec in plane.snapshot():
+                    if rec["state"] != "leased" or rec["pid"] is None:
+                        continue
+                    tid = drv.server.reservations.get_assigned_trial(
+                        rec["pid"])
+                    if tid is None:
+                        continue
+                    drv.telemetry.event(
+                        "chaos", kind="kill_agent", trial=tid,
+                        partition=rec["pid"], agent=rec["agent"])
+                    if not plane.kill_agent_by_runner(rec["runner"]):
+                        violations.append(
+                            "kill_agent could not signal agent {} "
+                            "(runner {})".format(rec["agent"],
+                                                 rec["runner"]))
+                    killed.update(agent=rec["agent"], trial=tid,
+                                  partition=rec["pid"])
+                    break
+                time.sleep(0.05)
+            if killed["agent"] is None:
+                violations.append(
+                    "no agent lease with a running trial within {:.0f}s "
+                    "— the kill was never injected".format(
+                        lease_timeout_s))
+            result = {}
+            try:
+                result = sub.result(timeout=result_timeout_s)
+            except BaseException as e:  # noqa: BLE001 - a hung experiment IS the invariant-11 failure mode
+                violations.append(
+                    "experiment did not complete after the kill: {!r} — "
+                    "the requeue machinery under test likely lost the "
+                    "trial".format(e))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        if wit is not None and wit_installed_here \
+                and not _witness.enabled_by_env():
+            _witness.uninstall()
+    wall_s = time.time() - t0
+
+    if result and result.get("num_trials") != trials:
+        violations.append("experiment finished {} of {} trials".format(
+            result.get("num_trials"), trials))
+    # Experiment journal: lifecycle + exactly-once requeue for the kill.
+    exp_journal = None
+    report = None
+    for exp_dir in sorted(d for d in glob.glob(os.path.join(base_dir, "*"))
+                          if os.path.isdir(d) and d != fleet.home_dir):
+        jp = os.path.join(exp_dir, JOURNAL_NAME)
+        if os.path.exists(jp):
+            exp_journal = jp
+            report = check_invariants(read_events(jp),
+                                      stall_flag_bound_s=None)
+            violations.extend(report["violations"])
+    if exp_journal is None:
+        violations.append("no experiment journal found under "
+                          "{}".format(base_dir))
+    # Fleet journal: the lease-revocation half of invariant 11.
+    fleet_journal = os.path.join(fleet.home_dir, FLEET_JOURNAL_NAME)
+    replay = replay_fleet_journal(fleet_journal)
+    agents_replay = replay.get("agents") or {}
+    if agents_replay.get("joins", 0) < agents:
+        violations.append(
+            "only {} of {} agents ever joined the fleet".format(
+                agents_replay.get("joins", 0), agents))
+    if killed["agent"] is not None:
+        if agents_replay.get("losses", 0) < 1:
+            violations.append(
+                "agent {} was killed but the fleet journal carries no "
+                "agent 'lost' event".format(killed["agent"]))
+        if agents_replay.get("lost_leases", 0) < 1:
+            violations.append(
+                "agent {} was killed mid-lease but no lease ended with "
+                "reason=agent_lost".format(killed["agent"]))
+        elif agents_replay.get("lost_leases", 0) > 1:
+            violations.append(
+                "one kill produced {} agent_lost lease revocations "
+                "(expected exactly 1)".format(
+                    agents_replay["lost_leases"]))
+    witness_block = None
+    if wit is not None:
+        new_violations = wit.violations[wit_pre_violations:]
+        witness_block = {"edges": len(wit.edges),
+                         "violations": len(new_violations)}
+        for v in new_violations:
+            violations.append("lock-order witness: {}".format(v))
+    detail = {
+        "agents": agents,
+        "killed": killed,
+        "agents_replay": agents_replay,
+        "wall_s": round(wall_s, 1),
+        "witness": witness_block,
+    }
+    return {"ok": not violations, "violations": violations,
+            "detail": detail, "report": report,
+            "journal": exp_journal, "fleet_journal": fleet_journal,
+            "witness": witness_block, "base_dir": base_dir}
+
+
+def run_remote_scale_soak(experiments: int = 40, agents: int = 4,
+                          runners: int = 2, max_active: int = 8,
+                          trials_per_exp: int = 1, seed: int = 7,
+                          base_dir: Optional[str] = None,
+                          result_timeout_s: float = 600.0
+                          ) -> Dict[str, Any]:
+    """The remote half of ROADMAP item 4 ("nothing yet measures hundreds
+    of sockets"): the PR-11 churn driven by REAL agent processes over
+    sockets — every agent is a separate OS process dialing the shared
+    listener, every lease a full AJOIN/ABIND/REG/.../ADONE round trip.
+    Gates: every tenant completes, every agent joins, and remote leases
+    actually happened (the churn must not quietly drain through the
+    thread runners alone). Records ``detail.remote``: agent join
+    latency p50/p95 (process spawn -> fleet journal join), ABIND lease
+    round-trip p50/p95, and churn completion."""
+    import signal
+
+    from maggy_tpu import experiment
+    from maggy_tpu.fleet.scheduler import FleetSaturated
+    from maggy_tpu.telemetry import read_events
+    from maggy_tpu.telemetry.spans import _dist_stats
+
+    base_dir = base_dir or tempfile.mkdtemp(prefix="maggy_remote_scale_")
+    t0 = time.time()
+    fleet = Fleet(runners=runners, max_agents=agents,
+                  home_dir=os.path.join(base_dir, "fleet"),
+                  max_active=max_active, agent_liveness_s=10.0,
+                  preempt_grace_s=5.0)
+    procs = []
+    spawn_wall: List[float] = []
+    handles: Dict[str, Any] = {}
+    failures: Dict[str, str] = {}
+    try:
+        with fleet:
+            ticket = os.path.join(fleet.home_dir, "agent_ticket.json")
+            for i in range(agents):
+                spawn_wall.append(time.time())
+                procs.append(spawn_agent_process(
+                    ticket, log_path=os.path.join(
+                        base_dir, "agent{}.log".format(i))))
+            for i in range(experiments):
+                name = "remote{:04d}".format(i)
+                try:
+                    handles[name] = experiment.lagom_submit(
+                        scale_train_fn,
+                        _scale_config(name, trials_per_exp, base_dir,
+                                      seed + i),
+                        fleet=fleet, block=False, name=name)
+                except FleetSaturated:
+                    pass
+                except Exception as e:  # noqa: BLE001 - a real submission failure
+                    failures[name] = repr(e)
+            deadline = time.monotonic() + result_timeout_s
+            for name, handle in sorted(handles.items()):
+                try:
+                    left = max(1.0, deadline - time.monotonic())
+                    result = handle.result(timeout=left)
+                    if result.get("num_trials") != trials_per_exp:
+                        failures[name] = "finished {} of {} trials".format(
+                            result.get("num_trials"), trials_per_exp)
+                except BaseException as e:  # noqa: BLE001 - one tenant's failure is a finding
+                    failures[name] = repr(e)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+    wall_s = time.time() - t0
+
+    journal = os.path.join(fleet.home_dir, FLEET_JOURNAL_NAME)
+    replay = replay_fleet_journal(journal)
+    agents_replay = replay.get("agents") or {}
+    # Join latency: process spawn wall time -> the fleet journal's agent
+    # join stamp, matched in order (agents join in spawn order on an
+    # idle fleet; ties are within measurement noise).
+    join_ts = sorted(ev.get("t") for ev in read_events(journal)
+                     if ev.get("ev") == "agent"
+                     and ev.get("phase") == "join"
+                     and ev.get("t") is not None)
+    join_ms = [(t - s) * 1e3 for s, t in zip(spawn_wall, join_ts)
+               if t >= s]
+    # Remote leases: leases granted to agent-slot runners (runner index
+    # >= the thread-fleet size).
+    remote_leases = sum(1 for ev in read_events(journal)
+                        if ev.get("ev") == "lease"
+                        and ev.get("phase") == "start"
+                        and isinstance(ev.get("runner"), int)
+                        and ev["runner"] >= runners)
+    violations: List[str] = []
+    if failures:
+        sample = dict(list(sorted(failures.items()))[:5])
+        violations.append(
+            "{} of {} tenants failed/incomplete (sample: {})".format(
+                len(failures), len(handles), sample))
+    if agents_replay.get("joins", 0) < agents:
+        violations.append("only {} of {} agents joined".format(
+            agents_replay.get("joins", 0), agents))
+    if remote_leases < 1:
+        violations.append(
+            "no lease was ever granted to a remote agent — the churn "
+            "drained entirely through thread runners")
+    detail = {
+        "experiments": len(handles),
+        "completed": len(handles) - sum(1 for n in failures
+                                        if n in handles),
+        "failed": len(failures),
+        "agents": agents,
+        "agent_joins": agents_replay.get("joins", 0),
+        "agent_join_ms": _dist_stats(join_ms),
+        "abind_ms": agents_replay.get("abind_ms"),
+        "remote_leases": remote_leases,
+        "total_leases": agents_replay.get("leases", 0),
+        "wall_s": round(wall_s, 1),
+        "experiments_per_s": round(len(handles) / wall_s, 2)
+        if wall_s > 0 else None,
+        "decisions_per_s": replay.get("decisions_per_s"),
+        "admission_p99_ms": replay.get("admission_p99_ms"),
+    }
+    return {"ok": not violations, "violations": violations,
+            "detail": detail, "journal": journal, "base_dir": base_dir}
+
+
 def run_scale_soak(experiments: int = 520, runners: int = 8,
                    max_active: int = 12, seed: int = 7,
                    base_dir: Optional[str] = None,

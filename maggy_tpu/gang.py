@@ -168,10 +168,26 @@ def config_declares_gangs(config) -> bool:
     return False
 
 
+#: Process-wide jax.distributed rendezvous latch: ``initialize`` may run
+#: at most once per process, however many remote-gang trials this agent
+#: serves — later gangs in the same world reuse the first rendezvous.
+_RENDEZVOUS_LOCK = threading.Lock()
+_RENDEZVOUS_DONE = False
+
+
 class GangContext:
     """What the gang leader's train function receives as ``ctx.gang``:
     the assembled slice (chips + mesh axes + strategy) and helpers that
-    build the jax objects over exactly the gang's devices."""
+    build the jax objects over exactly the gang's devices.
+
+    Remote gangs (members living in DIFFERENT processes — fleet agents,
+    TPU-VM workers) additionally carry ``rendezvous``: the
+    driver-coordinated ``jax.distributed.initialize`` parameters
+    (coordinator address = the leader agent's advertised coord port,
+    process ids in chip order). ``ensure_rendezvous()`` joins that world
+    exactly once per process; ``build_mesh``/``sharding_env`` call it
+    implicitly, so the in-one-process assumption (runner ≈ chip by
+    index) is gone the moment the assignment says otherwise."""
 
     def __init__(self, info: Dict[str, Any]):
         self.chips: List[int] = [int(c) for c in info.get("chips", [])]
@@ -179,16 +195,67 @@ class GangContext:
         self.leader: Optional[int] = info.get("leader")
         self.mesh_shape: Dict[str, int] = dict(info.get("mesh", {}))
         self.strategy: str = info.get("strategy", "dp")
+        # Remote-gang rendezvous block (None for in-process gangs) and
+        # this member's own partition id (stamped into the assignment
+        # info at serve time) — together they resolve our process_id.
+        self.rendezvous: Optional[Dict[str, Any]] = \
+            dict(info["rendezvous"]) if info.get("rendezvous") else None
+        self.partition: Optional[int] = info.get("partition")
 
     @property
     def size(self) -> int:
         return len(self.chips)
 
+    @property
+    def process_id(self) -> Optional[int]:
+        """This member's jax.distributed process id (0 = the leader),
+        or None for in-process gangs."""
+        if self.rendezvous is None or self.partition is None:
+            return None
+        pid = (self.rendezvous.get("process_ids") or {}).get(
+            str(int(self.partition)))
+        return None if pid is None else int(pid)
+
+    def ensure_rendezvous(self) -> bool:
+        """Join the gang's cross-process world via
+        ``jax.distributed.initialize`` — once per process (jax allows
+        exactly one distributed runtime; a later remote gang in the
+        same agent process REUSES the first world, so keep an agent
+        pool's world membership stable across gangs — re-shaping the
+        world needs fresh agent processes). No-op (False) for
+        in-process gangs; True when the world is up (joined now or
+        earlier)."""
+        global _RENDEZVOUS_DONE
+
+        if self.rendezvous is None:
+            return False
+        with _RENDEZVOUS_LOCK:
+            if _RENDEZVOUS_DONE:
+                return True
+            process_id = self.process_id
+            if process_id is None:
+                raise RuntimeError(
+                    "gang rendezvous info names no process id for "
+                    "partition {!r} (process_ids: {})".format(
+                        self.partition,
+                        self.rendezvous.get("process_ids")))
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=self.rendezvous["coordinator"],
+                num_processes=int(self.rendezvous["num_processes"]),
+                process_id=process_id)
+            _RENDEZVOUS_DONE = True
+        return True
+
     def devices(self):
         """The gang's jax devices, in chip order (runner ≈ chip: chip i
-        is ``jax.devices()[i]`` on an in-process fleet / CPU proxy)."""
+        is ``jax.devices()[i]`` on an in-process fleet / CPU proxy; in a
+        rendezvous'd remote gang ``jax.devices()`` is the GLOBAL device
+        list, same indexing contract across every member process)."""
         import jax
 
+        self.ensure_rendezvous()
         devs = jax.devices()
         return [devs[c] for c in self.chips]
 
@@ -196,6 +263,7 @@ class GangContext:
         """Named mesh over the gang's contiguous device slice."""
         from maggy_tpu.parallel.mesh import slice_mesh
 
+        self.ensure_rendezvous()
         return slice_mesh(self.chips, self.mesh_shape)
 
     def sharding_env(self):
@@ -204,9 +272,12 @@ class GangContext:
         return ShardingEnv(self.build_mesh())
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"chips": list(self.chips), "members": list(self.members),
-                "leader": self.leader, "mesh": dict(self.mesh_shape),
-                "strategy": self.strategy}
+        out = {"chips": list(self.chips), "members": list(self.members),
+               "leader": self.leader, "mesh": dict(self.mesh_shape),
+               "strategy": self.strategy}
+        if self.rendezvous is not None:
+            out["rendezvous"] = dict(self.rendezvous)
+        return out
 
 
 # ------------------------------------------------------------------ placer

@@ -319,6 +319,29 @@ def render_fleet(status: Dict[str, Any],
                 name, exp.get("state"), exp.get("priority"),
                 exp.get("weight"), exp.get("allocated"), exp.get("leases"),
                 exp.get("preemptions"), qw, extra))
+    agents = status.get("agents") or []
+    if agents or status.get("max_agents"):
+        lines.append("agents: {} joined / {} slot(s)".format(
+            len(agents), status.get("max_agents", "?")))
+        for a in agents:
+            lines.append(
+                "  {} [runner {}, {}@{}, {} chip(s)]: {}{}, {} lease(s), "
+                "last beat {}s ago".format(
+                    a.get("agent"), a.get("runner"),
+                    a.get("process_index"), a.get("host"), a.get("chips"),
+                    a.get("state"),
+                    " -> {}".format(a.get("lease")) if a.get("lease")
+                    else "",
+                    a.get("leases"), a.get("last_beat_age_s")))
+    areplay = replay.get("agents") or {}
+    if areplay.get("joins"):
+        abind = areplay.get("abind_ms") or {}
+        lines.append(
+            "agent plane: {} join(s), {} lease(s) delivered (abind p50 "
+            "{} ms / p95 {} ms), {} lost ({} lease(s) revoked)".format(
+                areplay.get("joins"), areplay.get("leases"),
+                abind.get("median_ms"), abind.get("p95_ms"),
+                areplay.get("losses", 0), areplay.get("lost_leases", 0)))
     if replay.get("share_error") is not None:
         lines.append("share error vs weights: {} (overlap window)".format(
             replay["share_error"]))

@@ -68,6 +68,9 @@ EVENT_KINDS = frozenset({
                               #   dispatch queue (scope="rpc", tenant
                               #   journal) — rpc.SharedServer /
                               #   fleet.FleetScheduler
+    "agent",                  # remote fleet-agent lifecycle (phase:
+                              #   AGENT_PHASES — fleet journal lane per
+                              #   agent; maggy_tpu.fleet.agent)
 })
 
 #: ``reason=`` on a trial ``requeued`` phase: why it re-entered the
@@ -94,8 +97,16 @@ FLEET_PHASES = frozenset({"start", "stop"})
 #: fleet_experiment mirrors the scheduler entry states.
 FLEET_EXPERIMENT_PHASES = frozenset({"start", "done", "failed"})
 LEASE_PHASES = frozenset({"start", "end"})
-#: ``reason=`` on a lease ``end``.
-LEASE_END_REASONS = frozenset({"released", "error"})
+#: ``reason=`` on a lease ``end``. ``agent_lost`` = the remote agent
+#: serving the lease went silent past the liveness bound mid-lease (the
+#: fleet revoked it; the experiment's own slot-reclaim liveness requeues
+#: the trial exactly once).
+LEASE_END_REASONS = frozenset({"released", "error", "agent_lost"})
+#: ``phase=`` on an ``agent`` event: one remote agent's lifecycle in the
+#: fleet journal — join (AJOIN admitted), lease (ABIND delivered), done
+#: (ADONE received, lease closed), lost (silent past the liveness
+#: bound), leave (orderly exit / fleet shutdown).
+AGENT_PHASES = frozenset({"join", "lease", "done", "lost", "leave"})
 
 #: Chaos fault kinds — the ``kind=`` field of ``ev: "chaos"`` injection
 #: records (mirrors chaos/plan.py KINDS; the chaos plan validates kinds
@@ -111,6 +122,11 @@ CHAOS_KINDS = frozenset({
     # ONE experiment's handle_message, which per-verb plan targeting
     # cannot express (partition ids overlap across tenants).
     "slow_tenant",
+    # Agent soak (fleet/soak.py run_agent_soak): a remote agent process
+    # SIGKILLed mid-lease — invariant 11 (lease revoked, trial requeued
+    # exactly once). Harness-injected like slow_tenant: the chaos plan's
+    # pool-level kill cannot reach an agent in another OS process.
+    "kill_agent",
 })
 
 #: Health-engine event fields (``ev: "health"``).
@@ -121,13 +137,13 @@ HEALTH_CHECKS = frozenset({"engine", "straggler", "hb_rtt", "hang"})
 #: the journalvocab checker verifies consumer literals into.
 ALL_PHASES = (frozenset(SPAN_PHASES) | EXPERIMENT_PHASES | RUNNER_PHASES
               | WORKER_PHASES | FLEET_PHASES | FLEET_EXPERIMENT_PHASES
-              | LEASE_PHASES)
+              | LEASE_PHASES | AGENT_PHASES)
 ALL_REASONS = REQUEUE_REASONS | LEASE_END_REASONS | PROFILE_REASONS
 
 __all__ = [
     "SPAN_PHASES", "EVENT_KINDS", "REQUEUE_REASONS", "PROFILE_REASONS",
     "EXPERIMENT_PHASES", "RUNNER_PHASES", "WORKER_PHASES",
     "FLEET_PHASES", "FLEET_EXPERIMENT_PHASES", "LEASE_PHASES",
-    "LEASE_END_REASONS", "CHAOS_KINDS", "HEALTH_STATUSES",
-    "HEALTH_CHECKS", "ALL_PHASES", "ALL_REASONS",
+    "LEASE_END_REASONS", "AGENT_PHASES", "CHAOS_KINDS",
+    "HEALTH_STATUSES", "HEALTH_CHECKS", "ALL_PHASES", "ALL_REASONS",
 ]

@@ -66,6 +66,14 @@ def pytest_configure(config):
         "Select with -m fleet.")
     config.addinivalue_line(
         "markers",
+        "agent: remote fleet-agent tests (maggy_tpu.fleet.agent) — "
+        "fleet tickets, the AJOIN/ABIND/ADONE wire contract, "
+        "cross-experiment re-binding, agent-death lease revocation "
+        "(invariant 11), and remote-gang rendezvous wiring. The real-"
+        "subprocess soak is additionally marked slow. Select with "
+        "-m agent.")
+    config.addinivalue_line(
+        "markers",
         "scale: service-scale control-plane tests — SharedServer "
         "per-tenant dispatch pools, multi-hundred-tenant routing stress, "
         "batched heartbeats, indexed fleet admission/shedding, and the "

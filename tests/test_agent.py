@@ -1,0 +1,788 @@
+"""Remote fleet agents (maggy_tpu/fleet/agent.py): the cross-process
+fleet.
+
+Covers the ABIND wire contract over a real socket (AJOIN/ALEASE/ADONE),
+fleet-ticket parsing, lease delivery and re-binding one agent across TWO
+experiments, agent-death lease revocation + exactly-once trial requeue
+(chaos invariant 11), remote-gang rendezvous wiring (driver-stamped
+jax.distributed coordinates, member program delivery), the per-agent
+observability surface, the CLI, and the journal/replay additions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from maggy_tpu import experiment
+from maggy_tpu.core.rpc import Client, MessageSocket
+from maggy_tpu.fleet import (AGENT_TICKET_NAME, FLEET_JOURNAL_NAME, Fleet,
+                             FleetAgent, read_fleet_ticket,
+                             replay_fleet_journal)
+from maggy_tpu.fleet.agent import (_AgentChannel, reserve_coord_addr,
+                                   train_fn_path)
+from maggy_tpu.fleet.soak import _scale_config, agent_train_fn, scale_train_fn
+from maggy_tpu.telemetry import JOURNAL_NAME, read_events
+
+pytestmark = pytest.mark.agent
+
+
+def _fleet(base_dir, runners=1, max_agents=1, liveness=5.0, **kwargs):
+    return Fleet(runners=runners, max_agents=max_agents,
+                 home_dir=os.path.join(str(base_dir), "fleet"),
+                 agent_liveness_s=liveness, **kwargs)
+
+
+def _ticket(fleet, wait_s=5.0):
+    return read_fleet_ticket(
+        os.path.join(fleet.home_dir, AGENT_TICKET_NAME), wait_s=wait_s)
+
+
+def _cfg(name, trials, base_dir, seed=1, **over):
+    cfg = _scale_config(name, trials, str(base_dir), seed, telemetry=True)
+    return dataclasses.replace(cfg, **over) if over else cfg
+
+
+def _exp_journals(base_dir, fleet):
+    for d in sorted(glob.glob(os.path.join(str(base_dir), "*"))):
+        if not os.path.isdir(d) or d == fleet.home_dir:
+            continue
+        jp = os.path.join(d, JOURNAL_NAME)
+        if os.path.exists(jp):
+            yield jp
+
+
+# ------------------------------------------------------------------ helpers
+
+
+class TestTrainFnPath:
+    def test_module_level_fn_resolves(self):
+        assert train_fn_path(scale_train_fn) == \
+            "maggy_tpu.fleet.soak:scale_train_fn"
+
+    def test_lambda_and_closure_are_unnameable(self):
+        assert train_fn_path(lambda x: x) is None
+
+        def closure(x):
+            return x
+
+        assert train_fn_path(closure) is None
+
+    def test_renamed_binding_is_unnameable(self):
+        # A module attribute that does not resolve back to the object
+        # would make the agent import a DIFFERENT function.
+        def imposter():
+            pass
+
+        imposter.__module__ = "maggy_tpu.fleet.soak"
+        imposter.__qualname__ = "scale_train_fn"
+        assert train_fn_path(imposter) is None
+
+
+class TestFleetTicket:
+    def test_roundtrip(self, tmp_path):
+        with _fleet(tmp_path) as fleet:
+            ticket = _ticket(fleet)
+            assert ticket["secret"]
+            assert ticket["fleet"] == fleet.name
+            assert ticket["max_agents"] == 1
+            assert isinstance(ticket["port"], int)
+
+    def test_missing_ticket_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            read_fleet_ticket(str(tmp_path / "nope.json"), wait_s=0.0)
+
+    def test_partial_write_retries_then_loads(self, tmp_path):
+        path = tmp_path / "t.json"
+        path.write_text('{"host": "x"')  # torn write
+
+        def fix():
+            time.sleep(0.3)
+            path.write_text(json.dumps(
+                {"host": "h", "port": 1, "secret": "s"}))
+
+        threading.Thread(target=fix, daemon=True).start()
+        ticket = read_fleet_ticket(str(path), wait_s=5.0)
+        assert ticket["host"] == "h"
+
+    def test_reserve_coord_addr_shape(self):
+        host, _, port = reserve_coord_addr().rpartition(":")
+        assert host == "127.0.0.1" and int(port) > 0
+
+
+# -------------------------------------------------------------- wire verbs
+
+
+class TestAgentWire:
+    def test_join_lease_done_roundtrip(self, tmp_path):
+        """The full AJOIN -> ALEASE(OK) -> ABIND -> ADONE contract over
+        a real socket, raw frames (no FleetAgent sugar). runners=0: the
+        fake agent must be the one leased, not a thread runner."""
+        with _fleet(tmp_path, runners=0, max_agents=2) as fleet:
+            t = _ticket(fleet)
+            ch = _AgentChannel((t["host"], t["port"]), t["secret"])
+            j = ch.call({"type": "AJOIN", "host": "h1", "chips": 2,
+                         "process_index": 3, "coord_addr": "127.0.0.1:9",
+                         "os_pid": os.getpid(), "agent": None})
+            assert j["type"] == "AJOIN" and j["agent"]
+            assert j["poll_s"] > 0 and j["liveness_s"] > 0
+            # Idle fleet: nothing to lease.
+            assert ch.call({"type": "ALEASE",
+                            "agent": j["agent"]})["type"] == "OK"
+            # Capacity declaration landed in the registry.
+            snap = fleet.status()["agents"]
+            assert snap[0]["chips"] == 2 and snap[0]["process_index"] == 3
+            # Submit work -> the poll returns an ABIND with the target
+            # experiment's secret + executor config + dotted train fn.
+            h = experiment.lagom_submit(
+                scale_train_fn, _cfg("wire", 1, tmp_path), fleet=fleet,
+                block=False, name="wire")
+            lease = None
+            deadline = time.monotonic() + 30
+            while lease is None and time.monotonic() < deadline:
+                r = ch.call({"type": "ALEASE", "agent": j["agent"]})
+                if r["type"] == "ABIND":
+                    lease = r
+                else:
+                    time.sleep(0.05)
+            assert lease is not None
+            assert lease["exp"] == "wire"
+            assert lease["train_fn"] == \
+                "maggy_tpu.fleet.soak:scale_train_fn"
+            assert "warm_start" in lease
+            assert lease["secret"] and lease["secret"] != t["secret"]
+            # A retried ALEASE re-serves the SAME lease (lost reply).
+            again = ch.call({"type": "ALEASE", "agent": j["agent"]})
+            assert again["type"] == "ABIND"
+            assert again["partition_id"] == lease["partition_id"]
+            # Serve it like the executor would, then ADONE.
+            cl = Client((t["host"], t["port"]), lease["partition_id"], 0,
+                        lease["hb_interval"], lease["secret"])
+            reporter = _FakeReporter()
+            cl.register()
+            cl.start_heartbeat(reporter)
+            tid, params = cl.get_suggestion(timeout=20)
+            assert tid is not None
+            reporter.trial_id = tid  # the FINAL must name the trial
+            resp = cl.finalize_metric(0.5, reporter)
+            assert resp["type"] in ("OK", "GSTOP", "TRIAL")
+            assert ch.call({"type": "ADONE", "agent": j["agent"],
+                            "error": None})["type"] == "OK"
+            assert h.result(timeout=60)["num_trials"] == 1
+            cl.stop()
+            ch.close()
+
+    def test_unknown_agent_and_full_fleet_rejected(self, tmp_path):
+        with _fleet(tmp_path, max_agents=1) as fleet:
+            t = _ticket(fleet)
+            ch = _AgentChannel((t["host"], t["port"]), t["secret"])
+            assert ch.call({"type": "ALEASE",
+                            "agent": "a0-dead"})["type"] == "ERR"
+            j = ch.call({"type": "AJOIN", "host": "h", "chips": 1,
+                         "process_index": 0, "coord_addr": None,
+                         "os_pid": None, "agent": None})
+            assert j["type"] == "AJOIN"
+            full = ch.call({"type": "AJOIN", "host": "h2", "chips": 1,
+                            "process_index": 0, "coord_addr": None,
+                            "os_pid": None, "agent": None})
+            assert full["type"] == "ERR" and "full" in full["error"]
+            ch.close()
+
+    def test_agent_verbs_rejected_without_plane(self, tmp_path):
+        from maggy_tpu.core.rpc import FleetAgentServer
+
+        server = FleetAgentServer(1)
+        for verb in ("AJOIN", "ALEASE", "ADONE"):
+            resp = server.handle_message({"type": verb, "agent": "x"})
+            assert resp["type"] == "ERR"
+
+
+class _FakeReporter:
+    """Minimal reporter stand-in for driving a Client by hand."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.trial_id = None
+
+    def get_data(self):
+        return {"metric": None, "step": None, "logs": [],
+                "trial_id": self.trial_id, "span": None}
+
+    def reset(self, **kwargs):
+        pass
+
+    def log(self, *a, **k):
+        pass
+
+    def early_stop(self, **kwargs):
+        pass
+
+
+# -------------------------------------------------- lease + rebind e2e
+
+
+class TestAgentRebind:
+    def test_one_agent_two_experiments(self, tmp_path):
+        """The acceptance shape, in-thread: one agent is leased to
+        experiment A, released, re-bound to experiment B on the same
+        fleet; both complete with thread-runner-shaped results and the
+        fleet journal carries the agent's join/lease/done lanes."""
+        with _fleet(tmp_path, runners=1, max_agents=1) as fleet:
+            agent = FleetAgent(_ticket(fleet))
+            agent.join()
+            t = threading.Thread(target=agent.run,
+                                 kwargs=dict(max_leases=2), daemon=True)
+            t.start()
+            r1 = experiment.lagom_submit(
+                scale_train_fn, _cfg("reb1", 3, tmp_path, 1), fleet=fleet,
+                block=False, name="reb1").result(timeout=90)
+            r2 = experiment.lagom_submit(
+                scale_train_fn, _cfg("reb2", 3, tmp_path, 2), fleet=fleet,
+                block=False, name="reb2").result(timeout=90)
+            t.join(timeout=60)
+        for r in (r1, r2):
+            # Journal-replayed result shape identical to thread runs.
+            assert r["num_trials"] == 3
+            assert r["best_val"] is not None and r["best_id"]
+        assert agent.leases_served == 2
+        events = read_events(
+            os.path.join(fleet.home_dir, FLEET_JOURNAL_NAME))
+        phases = [(e.get("phase"), e.get("exp")) for e in events
+                  if e.get("ev") == "agent"]
+        assert ("join", None) == (phases[0][0], None)
+        leased_exps = {exp for ph, exp in phases if ph == "lease"}
+        assert leased_exps == {"reb1", "reb2"}
+        assert sum(1 for ph, _ in phases if ph == "done") == 2
+        replay = replay_fleet_journal(
+            os.path.join(fleet.home_dir, FLEET_JOURNAL_NAME))
+        assert replay["agents"]["joins"] == 1
+        assert replay["agents"]["leases"] == 2
+        assert replay["agents"]["losses"] == 0
+        assert replay["agents"]["abind_ms"]["n"] == 2
+
+    def test_closure_train_fn_stays_on_threads(self, tmp_path):
+        """An experiment whose train fn can't be named on the wire must
+        complete on thread runners with the agent never leased to it."""
+        captured = []
+
+        def closure_fn(lr, units, reporter=None):
+            captured.append(lr)
+            return {"metric": float(lr)}
+
+        with _fleet(tmp_path, runners=1, max_agents=1) as fleet:
+            agent = FleetAgent(_ticket(fleet))
+            agent.join()
+            t = threading.Thread(target=agent.run, daemon=True)
+            t.start()
+            r = experiment.lagom_submit(
+                closure_fn, _cfg("clo", 2, tmp_path), fleet=fleet,
+                block=False, name="clo").result(timeout=90)
+            assert r["num_trials"] == 2
+            assert agent.leases_served == 0
+            agent.stop()
+            t.join(timeout=10)
+
+
+# --------------------------------------------------- invariant 11 (death)
+
+
+class TestAgentDeath:
+    def test_mid_lease_death_revokes_and_requeues_once(self, tmp_path):
+        """Invariant 11, unit form: a fake agent takes a lease, REGs,
+        receives a trial, and vanishes. The experiment's slot-reclaim
+        liveness must requeue the trial EXACTLY once, the fleet must end
+        the lease with reason=agent_lost and mark the agent lost, and
+        the schedule must complete on the surviving thread runner."""
+        with _fleet(tmp_path, runners=1, max_agents=1,
+                    liveness=2.0) as fleet:
+            t = _ticket(fleet)
+            ch = _AgentChannel((t["host"], t["port"]), t["secret"])
+            j = ch.call({"type": "AJOIN", "host": "fake", "chips": 1,
+                         "process_index": 0, "coord_addr": None,
+                         "os_pid": None, "agent": None})
+            h = experiment.lagom_submit(
+                agent_train_fn,
+                _cfg("death", 3, tmp_path, hb_loss_timeout=1.0,
+                     hb_interval=0.05),
+                fleet=fleet, block=False, name="death")
+            lease = None
+            deadline = time.monotonic() + 30
+            while lease is None and time.monotonic() < deadline:
+                r = ch.call({"type": "ALEASE", "agent": j["agent"]})
+                if r["type"] == "ABIND":
+                    lease = r
+                else:
+                    time.sleep(0.05)
+            assert lease is not None
+            cl = Client((t["host"], t["port"]), lease["partition_id"], 0,
+                        lease["hb_interval"], lease["secret"])
+            cl.register()
+            tid, _params = cl.get_suggestion(timeout=20)
+            assert tid is not None
+            # Vanish mid-lease: no FINAL, no heartbeats, sockets dead.
+            for s in (cl._sock, cl._hb_sock):
+                s.close()
+            ch.close()
+            assert h.result(timeout=120)["num_trials"] == 3
+        requeues = []
+        for jp in _exp_journals(tmp_path, fleet):
+            for ev in read_events(jp):
+                if ev.get("ev") == "trial" \
+                        and ev.get("phase") == "requeued" \
+                        and ev.get("trial") == tid:
+                    requeues.append(ev)
+        assert len(requeues) == 1, requeues
+        assert requeues[0].get("reason") == "heartbeat_loss"
+        replay = replay_fleet_journal(
+            os.path.join(fleet.home_dir, FLEET_JOURNAL_NAME))
+        assert replay["agents"]["losses"] == 1
+        assert replay["agents"]["lost_leases"] == 1
+        assert fleet.status()["agents"][0]["state"] == "lost"
+
+    def test_death_before_reg_frees_lease_cleanly(self, tmp_path):
+        """An agent that takes an ABIND but dies before REG: the lease
+        closes as agent_lost, no trial was assigned, and the experiment
+        completes untouched on the thread runner."""
+        with _fleet(tmp_path, runners=1, max_agents=1,
+                    liveness=1.0) as fleet:
+            t = _ticket(fleet)
+            ch = _AgentChannel((t["host"], t["port"]), t["secret"])
+            j = ch.call({"type": "AJOIN", "host": "fake", "chips": 1,
+                         "process_index": 0, "coord_addr": None,
+                         "os_pid": None, "agent": None})
+            h = experiment.lagom_submit(
+                scale_train_fn,
+                _cfg("prereg", 2, tmp_path, hb_loss_timeout=1.0),
+                fleet=fleet, block=False, name="prereg")
+            deadline = time.monotonic() + 30
+            got = None
+            while got is None and time.monotonic() < deadline:
+                r = ch.call({"type": "ALEASE", "agent": j["agent"]})
+                got = r if r["type"] == "ABIND" else None
+                time.sleep(0.05)
+            ch.close()  # die silently, never REG
+            assert h.result(timeout=120)["num_trials"] == 2
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                snap = fleet.status()["agents"]
+                if snap and snap[0]["state"] == "lost":
+                    break
+                time.sleep(0.1)
+        replay = replay_fleet_journal(
+            os.path.join(fleet.home_dir, FLEET_JOURNAL_NAME))
+        assert replay["agents"]["lost_leases"] == 1
+
+    def test_check_invariants_kill_agent(self):
+        """Invariant 11 in the offline checker: a kill_agent chaos
+        event demands exactly one requeue — none is a lost lease,
+        more than one kill-count is a duplicate, and a FINAL without a
+        requeue is a phantom delivery from a dead agent."""
+        from maggy_tpu.chaos.harness import check_invariants
+
+        def evs(requeues, finals=1):
+            out = [{"ev": "experiment", "phase": "start", "t": 0.0},
+                   {"ev": "trial", "trial": "t1", "phase": "queued",
+                    "t": 1.0},
+                   {"ev": "chaos", "kind": "kill_agent", "trial": "t1",
+                    "partition": 0, "agent": "a1", "t": 2.0}]
+            for i in range(requeues):
+                out.append({"ev": "trial", "trial": "t1",
+                            "phase": "requeued",
+                            "reason": "heartbeat_loss", "t": 3.0 + i})
+            for i in range(finals):
+                out.append({"ev": "trial", "trial": "t1",
+                            "phase": "finalized", "t": 6.0 + i})
+            out.append({"ev": "experiment", "phase": "end", "t": 9.0})
+            return out
+
+        ok = check_invariants(evs(1), stall_flag_bound_s=None)
+        assert ok["ok"], ok["violations"]
+        assert ok["recoveries"][0]["kind"] == "kill_agent"
+        assert ok["recoveries"][0]["outcome"] == "requeued"
+        missing = check_invariants(evs(0), stall_flag_bound_s=None)
+        assert any("no requeue" in v for v in missing["violations"])
+        double = check_invariants(evs(2), stall_flag_bound_s=None)
+        assert any("duplicate requeue" in v
+                   for v in double["violations"])
+
+
+# ------------------------------------------------- remote-gang rendezvous
+
+
+class TestRemoteGangRendezvous:
+    def test_gang_context_process_ids(self):
+        from maggy_tpu.gang import GangContext
+
+        info = {"chips": [0, 1], "members": [0, 1], "leader": 0,
+                "mesh": {"data": 2}, "strategy": "dp",
+                "rendezvous": {"coordinator": "127.0.0.1:1234",
+                               "num_processes": 2,
+                               "process_ids": {"0": 0, "1": 1}},
+                "partition": 1}
+        ctx = GangContext(info)
+        assert ctx.process_id == 1
+        assert ctx.to_dict()["rendezvous"]["num_processes"] == 2
+        # In-process gang: no rendezvous, ensure is a no-op.
+        local = GangContext({"chips": [0], "members": [0], "leader": 0,
+                             "mesh": {"data": 1}, "strategy": "dp"})
+        assert local.process_id is None
+        assert local.ensure_rendezvous() is False
+
+    def test_ensure_rendezvous_initializes_once(self, monkeypatch):
+        import jax
+
+        from maggy_tpu import gang as gang_mod
+
+        calls = []
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: calls.append(kw))
+        monkeypatch.setattr(gang_mod, "_RENDEZVOUS_DONE", False)
+        info = {"chips": [0, 1], "members": [0, 1], "leader": 0,
+                "mesh": {"data": 2}, "strategy": "dp",
+                "rendezvous": {"coordinator": "127.0.0.1:4321",
+                               "num_processes": 2,
+                               "process_ids": {"0": 0, "1": 1}},
+                "partition": 0}
+        ctx = gang_mod.GangContext(info)
+        assert ctx.ensure_rendezvous() is True
+        assert ctx.ensure_rendezvous() is True  # latched
+        assert calls == [{"coordinator_address": "127.0.0.1:4321",
+                          "num_processes": 2, "process_id": 0}]
+
+    def test_ensure_rendezvous_without_partition_raises(self, monkeypatch):
+        from maggy_tpu import gang as gang_mod
+
+        monkeypatch.setattr(gang_mod, "_RENDEZVOUS_DONE", False)
+        ctx = gang_mod.GangContext(
+            {"chips": [0, 1], "members": [0, 1], "leader": 0,
+             "mesh": {"data": 2}, "strategy": "dp",
+             "rendezvous": {"coordinator": "c:1", "num_processes": 2,
+                            "process_ids": {"0": 0, "1": 1}}})
+        with pytest.raises(RuntimeError, match="process id"):
+            ctx.ensure_rendezvous()
+
+    def test_remote_gang_over_two_agents(self, tmp_path, monkeypatch):
+        """Wiring e2e on a fake 2-process world: a 2-chip gang assembles
+        across TWO agents; the driver stamps jax.distributed rendezvous
+        coordinates (coordinator = the leader agent's advertised
+        address, process ids in chip order), the MEMBER receives the
+        SPMD program too (gang_role=member, runs it, never finalizes),
+        and both member and leader join the rendezvous — exactly one
+        ``jax.distributed.initialize`` per process (here: one, both
+        agents share the test process and the latch)."""
+        import jax
+
+        from maggy_tpu import OptimizationConfig, Searchspace
+        from maggy_tpu import gang as gang_mod
+        from maggy_tpu.gang import GangSpec
+
+        init_calls = []
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: init_calls.append(kw))
+        monkeypatch.setattr(gang_mod, "_RENDEZVOUS_DONE", False)
+        train_calls = []
+        orig_fn = gang_mod.gang_train_fn
+
+        def recording_fn(lr, budget=1, gang=None, reporter=None, ctx=None):
+            train_calls.append({
+                "process_id": ctx.gang.process_id if ctx and ctx.gang
+                else None,
+                "role": "leader" if reporter is not None else "member"})
+            return orig_fn(lr, budget=budget, gang=gang,
+                           reporter=reporter, ctx=ctx)
+
+        recording_fn.__module__ = "maggy_tpu.gang"
+        recording_fn.__qualname__ = "gang_train_fn"
+        monkeypatch.setattr(gang_mod, "gang_train_fn", recording_fn)
+
+        cfg = OptimizationConfig(
+            name="rgang", num_trials=1, optimizer="randomsearch",
+            searchspace=Searchspace(
+                lr=("DOUBLE", [0.05, 0.2]),
+                gang=("GANG", [GangSpec(2)])),
+            direction="max", num_workers=2, hb_interval=0.05,
+            hb_loss_timeout=5.0, seed=3, es_policy="none",
+            experiment_dir=str(tmp_path), telemetry=True, health=False)
+        with _fleet(tmp_path, runners=0, max_agents=2,
+                    liveness=10.0) as fleet:
+            agents = [FleetAgent(_ticket(fleet)) for _ in range(2)]
+            threads = []
+            for a in agents:
+                a.join()
+                th = threading.Thread(target=a.run, daemon=True)
+                th.start()
+                threads.append(th)
+            r = experiment.lagom_submit(
+                gang_mod.gang_train_fn, cfg, fleet=fleet, block=False,
+                name="rgang").result(timeout=120)
+            assert r["num_trials"] == 1
+            for a in agents:
+                a.stop()
+        # The driver stamped the rendezvous with the leader's coord
+        # address; both programs ran; initialize fired exactly once in
+        # this (shared) process.
+        coords = {a.coord_addr for a in agents}
+        assert len(init_calls) == 1
+        assert init_calls[0]["num_processes"] == 2
+        assert init_calls[0]["coordinator_address"] in coords
+        assert init_calls[0]["process_id"] in (0, 1)
+        roles = sorted(c["role"] for c in train_calls)
+        assert roles == ["leader", "member"], train_calls
+        pids = {c["process_id"] for c in train_calls}
+        assert pids == {0, 1}
+        # Exactly one FINAL (the leader's) in the experiment journal.
+        finals = []
+        for jp in _exp_journals(tmp_path, fleet):
+            finals.extend(e for e in read_events(jp)
+                          if e.get("ev") == "trial"
+                          and e.get("phase") == "finalized")
+        assert len(finals) == 1
+
+    def test_in_process_gang_has_no_rendezvous(self, tmp_path):
+        """Thread-runner gangs (no host_port in any REG) must stay
+        bit-for-bit on the old path: no rendezvous block stamped."""
+        from maggy_tpu import OptimizationConfig, Searchspace
+        from maggy_tpu import gang as gang_mod
+        from maggy_tpu.gang import GangSpec
+
+        cfg = OptimizationConfig(
+            name="lgang", num_trials=1, optimizer="randomsearch",
+            searchspace=Searchspace(
+                lr=("DOUBLE", [0.05, 0.2]),
+                gang=("GANG", [GangSpec(2)])),
+            direction="max", num_workers=2, hb_interval=0.05,
+            hb_loss_timeout=5.0, seed=3, es_policy="none",
+            experiment_dir=str(tmp_path), telemetry=True, health=False,
+            pool="thread")
+        result = experiment.lagom(gang_mod.gang_train_fn, cfg)
+        assert result["num_trials"] == 1
+        exp_dirs = sorted(d for d in glob.glob(
+            os.path.join(str(tmp_path), "*")) if os.path.isdir(d))
+        trial_files = glob.glob(
+            os.path.join(exp_dirs[-1], "*", "trial.json"))
+        assert trial_files
+        for tf in trial_files:
+            with open(tf) as f:
+                d = json.load(f)
+            gang = (d.get("info") or {}).get("gang") or {}
+            assert "rendezvous" not in gang
+
+
+# ------------------------------------------------------------ scheduling
+
+
+class TestAgentScheduling:
+    def test_agent_slot_attach_reuse_and_targets(self, tmp_path):
+        from maggy_tpu.fleet.scheduler import FleetScheduler
+
+        sched = FleetScheduler(2, max_size=4)
+        a = sched.agent_slot_attach()
+        b = sched.agent_slot_attach()
+        assert (a, b) == (2, 3)
+        assert sched.fleet_size == 4
+        assert sched.is_agent_slot(a) and not sched.is_agent_slot(1)
+        sched.agent_slot_detach(a)
+        assert sched.live_agent_slots() == 1
+        # Reuse the vacant slot, not a new index.
+        assert sched.agent_slot_attach() == a
+        assert sched.fleet_size == 4
+
+    def test_agent_slot_never_binds_agentless_entry(self, tmp_path):
+        from maggy_tpu.fleet.scheduler import FleetPolicy, FleetScheduler
+
+        sched = FleetScheduler(1, max_size=2)
+        entry = sched.submit("noagent", FleetPolicy())
+        entry.train_fn_path = None
+
+        class _Drv:
+            experiment_done = False
+            exp_dir = None
+
+        sched.activate(entry, _Drv(), lambda pid: None, slots=2)
+        assert entry.agent_info is None
+        slot = sched.agent_slot_attach()
+        assert sched.next_binding(slot, timeout=0.4) is None
+        # The thread runner still binds it.
+        got = sched.next_binding(0, timeout=5.0)
+        assert got is not None and got[0] is entry
+
+    def test_build_agent_info_shape(self):
+        from maggy_tpu.fleet.scheduler import (ExperimentEntry,
+                                               FleetPolicy, FleetScheduler)
+
+        class _Cfg:
+            warm_start = False
+
+        class _Drv:
+            hb_interval = 0.5
+            exp_dir = "/tmp/x"
+            optimization_key = "metric"
+            config = _Cfg()
+
+            @staticmethod
+            def secret_for_clients():
+                return "s3cret"
+
+        entry = ExperimentEntry("e", FleetPolicy(), 0)
+        entry.train_fn_path = "m.mod:fn"
+        info = FleetScheduler._build_agent_info(entry, _Drv())
+        assert info == {"secret": "s3cret", "hb_interval": 0.5,
+                        "exp_dir": "/tmp/x", "optimization_key": "metric",
+                        "trial_type": "optimization",
+                        "warm_start": False, "train_fn": "m.mod:fn"}
+        entry.train_fn_path = None
+        assert FleetScheduler._build_agent_info(entry, _Drv()) is None
+
+
+# -------------------------------------------------------- obs + monitor
+
+
+class TestAgentObservability:
+    def test_agent_healthz_and_status(self, tmp_path):
+        import urllib.request
+
+        from maggy_tpu.telemetry import obs as obs_mod
+
+        with _fleet(tmp_path, runners=1, max_agents=1) as fleet:
+            agent = FleetAgent(_ticket(fleet), obs_port=0,
+                               home=str(tmp_path / "agent_home"))
+            agent.join()
+            th = threading.Thread(target=agent.run, daemon=True)
+            th.start()
+            deadline = time.monotonic() + 20
+            server = None
+            while time.monotonic() < deadline:
+                server = obs_mod.active_server()
+                if server is not None:
+                    break
+                time.sleep(0.05)
+            assert server is not None, "agent obs server never started"
+            host, port = server.address
+            with urllib.request.urlopen(
+                    "http://{}:{}/healthz".format(host, port),
+                    timeout=5) as resp:
+                assert resp.status == 200
+            with urllib.request.urlopen(
+                    "http://{}:{}/status".format(host, port),
+                    timeout=5) as resp:
+                body = json.loads(resp.read().decode())
+            assert any("fleet-agent" in json.dumps(v)
+                       for v in body.values())
+            agent.stop()
+            th.join(timeout=10)
+        assert obs_mod.active_server() is None
+
+    def test_render_fleet_agents_table(self):
+        from maggy_tpu.monitor import render_fleet
+
+        status = {"name": "f", "runners": 2, "active": 0,
+                  "queue_depth": 0, "max_agents": 2,
+                  "agents": [{"agent": "a1-ab", "runner": 2,
+                              "host": "vm1", "chips": 4,
+                              "process_index": 0, "state": "leased",
+                              "lease": "exp1", "pid": 0, "leases": 3,
+                              "last_beat_age_s": 0.1}],
+                  "experiments": []}
+        replay = {"agents": {"joins": 1, "leases": 3, "losses": 0,
+                             "lost_leases": 0,
+                             "abind_ms": {"median_ms": 5.0,
+                                          "p95_ms": 9.0, "n": 3}}}
+        out = render_fleet(status, replay)
+        assert "agents: 1 joined / 2 slot(s)" in out
+        assert "a1-ab" in out and "-> exp1" in out
+        assert "abind p50 5.0 ms" in out
+
+    def test_replay_agents_block_synthetic(self, tmp_path):
+        path = tmp_path / "fleet.jsonl"
+        rows = [
+            {"t": 1.0, "ev": "agent", "phase": "join", "agent": "a1"},
+            {"t": 2.0, "ev": "agent", "phase": "lease", "agent": "a1",
+             "exp": "e", "pid": 0, "abind_ms": 12.0},
+            {"t": 3.0, "ev": "lease", "phase": "start", "exp": "e",
+             "runner": 1, "pid": 0},
+            {"t": 4.0, "ev": "lease", "phase": "end", "exp": "e",
+             "runner": 1, "pid": 0, "reason": "agent_lost"},
+            {"t": 5.0, "ev": "agent", "phase": "lost", "agent": "a1"},
+        ]
+        path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        replay = replay_fleet_journal(str(path))
+        agents = replay["agents"]
+        assert agents["joins"] == 1
+        assert agents["losses"] == 1
+        assert agents["lost_leases"] == 1
+        assert agents["per_agent_leases"] == {"a1": 1}
+        assert agents["abind_ms"]["n"] == 1
+
+
+# ------------------------------------------------------------------- CLI
+
+
+class TestAgentCli:
+    def test_agent_requires_ticket_or_addr(self):
+        from maggy_tpu.fleet.__main__ import main
+
+        with pytest.raises(SystemExit):
+            main(["agent"])
+
+    def test_cli_subprocess_rebinds_across_experiments(self, tmp_path):
+        """THE acceptance criterion: an agent started as a separate OS
+        process via ``python -m maggy_tpu.fleet agent --ticket ...`` is
+        leased to one experiment, released, re-bound to a second on the
+        same fleet, and both complete with journal-replayed results of
+        the thread-runner shape."""
+        import signal
+
+        from maggy_tpu.fleet.soak import spawn_agent_process
+
+        # runners=0: every trial of both experiments MUST be served by
+        # the agent subprocess — nothing completes without the re-bind.
+        with _fleet(tmp_path, runners=0, max_agents=1,
+                    liveness=15.0) as fleet:
+            proc = spawn_agent_process(
+                os.path.join(fleet.home_dir, AGENT_TICKET_NAME),
+                log_path=str(tmp_path / "agent.log"))
+            try:
+                r1 = experiment.lagom_submit(
+                    scale_train_fn, _cfg("cli1", 3, tmp_path, 1),
+                    fleet=fleet, block=False,
+                    name="cli1").result(timeout=180)
+                r2 = experiment.lagom_submit(
+                    scale_train_fn, _cfg("cli2", 3, tmp_path, 2),
+                    fleet=fleet, block=False,
+                    name="cli2").result(timeout=180)
+            finally:
+                if proc.poll() is None:
+                    proc.send_signal(signal.SIGTERM)
+            for r in (r1, r2):
+                assert r["num_trials"] == 3
+                assert r["best_val"] is not None
+        replay = replay_fleet_journal(
+            os.path.join(fleet.home_dir, FLEET_JOURNAL_NAME))
+        assert replay["agents"]["joins"] == 1
+        assert replay["agents"]["leases"] == 2
+        assert replay["agents"]["losses"] == 0
+
+
+@pytest.mark.slow
+class TestAgentSoak:
+    def test_run_agent_soak(self, tmp_path):
+        """Invariant 11 end to end with REAL agent processes: one is
+        SIGKILLed mid-lease; the soak's own checks (exactly-once
+        requeue, lease revoked as agent_lost, schedule completes) must
+        all hold."""
+        from maggy_tpu.fleet.soak import run_agent_soak
+
+        report = run_agent_soak(agents=2, trials=4,
+                                base_dir=str(tmp_path),
+                                lock_witness=True)
+        assert report["ok"], report["violations"]
+        assert report["detail"]["killed"]["agent"] is not None
+        assert report["detail"]["agents_replay"]["lost_leases"] == 1
+        assert report["witness"] is None or \
+            report["witness"]["violations"] == 0
