@@ -1016,11 +1016,16 @@ def obs_main():
 
 def scale_main():
     """``bench.py --scale``: service-scale control-plane soak (see
-    maggy_tpu/fleet/soak.py run_scale_soak). Three phases against real
+    maggy_tpu/fleet/soak.py run_scale_soak). Four phases against real
     fleets: (1) a >=500-concurrent-experiment churn through one fleet
     (lagom_submit + the spool path) gating tenant completion, scheduler
-    decision throughput, and admission latency p99; (2) three weighted
-    resident tenants gating journal-replayed fair-share error; (3) the
+    decision throughput, and admission latency p99; (2) the SINK A/B —
+    the same churn with telemetry re-enabled through the fleet's journal
+    sink (``detail.sink``): decision throughput and admission p99 must
+    stay within 10% of the telemetry-off baseline and the sink's
+    replayed ingest lag p95 in bound — telemetry at churn scale must be
+    near-free (BENCH_SCALE_SINK=0 skips the arm); (3) three weighted
+    resident tenants gating journal-replayed fair-share error; (4) the
     slow-tenant A/B — per-tenant dispatch pools ON must hold the victim
     hand-off p95 isolation bound, and the pool-OFF (pre-fix shared-loop)
     arm must show the head-of-line inflation the pools remove. Always a
@@ -1075,8 +1080,15 @@ def scale_main():
     experiments = int(os.environ.get("BENCH_SCALE_EXPERIMENTS", "520"))
     runners = int(os.environ.get("BENCH_SCALE_RUNNERS", "8"))
     max_active = int(os.environ.get("BENCH_SCALE_MAX_ACTIVE", "12"))
+    sink_ab = os.environ.get("BENCH_SCALE_SINK", "1").strip().lower() \
+        not in ("0", "false", "off")
     report = run_scale_soak(experiments=experiments, runners=runners,
-                            max_active=max_active, seed=seed)
+                            max_active=max_active, seed=seed,
+                            sink_ab=sink_ab)
+    # The sink A/B block surfaces once, as detail.sink (popped from the
+    # soak detail so the record doesn't serialize it twice).
+    scale_detail = dict(report["detail"])
+    sink_detail = scale_detail.pop("sink", None)
     churn = report["detail"]["churn"]
     print(json.dumps({
         "metric": "scale soak ({} tenants / {} runners churn + weighted "
@@ -1088,7 +1100,8 @@ def scale_main():
             "seed": seed,
             "wall_s": round(time.time() - t0, 1),
             "violations": report["violations"],
-            "scale": report["detail"],
+            "scale": scale_detail,
+            "sink": sink_detail,
             "platform": platform_note,
             "journal": report["journal"],
         },

@@ -12,6 +12,7 @@ the standard soak runs: a runner killed mid-trial, a false preemption,
     python -m maggy_tpu.chaos --piggyback                # hand-off soak
     python -m maggy_tpu.chaos --preempt                  # preemption soak
     python -m maggy_tpu.chaos --agent                    # agent-kill soak
+    python -m maggy_tpu.chaos --sink                     # sink-kill soak
     python -m maggy_tpu.chaos --show-schedule --seed 7   # no experiment
 
 ``--preempt`` runs the graceful-preemption soak: a mid-trial trial is
@@ -100,6 +101,14 @@ def main(argv=None) -> int:
                          "mid-lease — the lease must be revoked "
                          "(reason=agent_lost) and the trial requeued "
                          "exactly once (invariant 11)")
+    ap.add_argument("--sink", action="store_true",
+                    help="run the journal-sink soak: tenants ship their "
+                         "telemetry through the fleet's journal sink, "
+                         "the sink is killed mid-soak and restarted — "
+                         "shippers must degrade to local journals and "
+                         "re-ship on reconnect with zero lost events, "
+                         "zero duplicates per event id, and zero "
+                         "experiment failures (invariant 12)")
     ap.add_argument("--show-schedule", action="store_true",
                     help="print the plan's deterministic decision "
                          "expansion and exit (no experiment)")
@@ -121,13 +130,25 @@ def main(argv=None) -> int:
     from maggy_tpu.chaos import harness
     from maggy_tpu.chaos.plan import FaultPlan
 
-    modes = [m for m in ("stall", "piggyback", "preempt", "gang", "agent")
+    modes = [m for m in ("stall", "piggyback", "preempt", "gang", "agent",
+                         "sink")
              if getattr(args, m)]
     if args.plan and modes:
         ap.error("--{} uses a built-in plan; drop --plan".format(modes[0]))
     if len(modes) > 1:
         ap.error("pick one of --stall / --piggyback / --preempt / --gang "
-                 "/ --agent")
+                 "/ --agent / --sink")
+    if args.sink:
+        # The sink soak owns its whole topology (a fleet whose sink
+        # tenant is detached/re-attached mid-run; the kill is
+        # harness-injected — the sink is fleet infrastructure no
+        # experiment plan can target) — delegate wholesale.
+        from maggy_tpu.fleet.soak import run_sink_soak
+
+        report = run_sink_soak(seed=7 if args.seed is None else args.seed,
+                               lock_witness=not args.no_witness)
+        print(json.dumps(report, indent=2, default=str))
+        return 0 if report["ok"] else 1
     if args.agent:
         # The agent soak owns its whole topology (a fleet with real
         # agent subprocesses; the kill is harness-injected, not a

@@ -84,6 +84,16 @@ class LagomConfig:
     #: classic single-tenant behavior bit-for-bit — ``lagom()`` is simply
     #: a fleet of one that owns its pool.
     fleet: Any = None
+    #: Fleet journal-sink routing (maggy_tpu.telemetry.sink): True makes
+    #: a FLEET-ATTACHED experiment ship its telemetry journal to the
+    #: fleet's journal sink over the shared socket (one process-wide
+    #: shipper thread, no per-tenant flusher — what re-enables telemetry
+    #: for 500-tenant churn) instead of writing <exp_dir>/telemetry.jsonl
+    #: directly; that local path becomes the degradation fallback the
+    #: shipper falls back to (and re-ships from) when the sink is down.
+    #: Ignored (plain local journal) without a fleet or with the fleet's
+    #: sink disabled. Default False: bit-for-bit the classic layout.
+    sink: bool = False
 
     def resolved_obs_port(self) -> Optional[int]:
         """The observability server port to bind, or None for off: the

@@ -65,9 +65,20 @@ class Driver(ABC):
         # costs the message hot path no I/O.
         from maggy_tpu.telemetry import JOURNAL_NAME, Telemetry
 
+        # Fleet-attached experiments may route the journal through the
+        # fleet's journal SINK (config.sink, telemetry/sink.py): events
+        # ship over the shared socket to <fleet_home>/journal/<name>.jsonl
+        # and the local path below becomes the degradation fallback.
+        sink_binding = None
+        sink_source = None
+        fleet_binding = getattr(config, "fleet", None)
+        if fleet_binding is not None and getattr(config, "sink", False):
+            sink_binding = fleet_binding.fleet.sink_binding()
+            sink_source = fleet_binding.entry.name
         self.telemetry = Telemetry(
             env=self.env, journal_path=self.exp_dir + "/" + JOURNAL_NAME,
-            enabled=getattr(config, "telemetry", True))
+            enabled=getattr(config, "telemetry", True),
+            sink=sink_binding, sink_source=sink_source)
         self.server.telemetry = self.telemetry
         if getattr(config, "resume", False):
             # One continuous journal across interruptions: replaying it

@@ -1127,7 +1127,9 @@ class FleetAgentServer(Server):
         if plane is None:
             return {"type": "ERR",
                     "error": "this fleet does not accept remote agents"}
-        return plane.agent_lease(agent=msg.get("agent"))
+        return plane.agent_lease(agent=msg.get("agent"),
+                                 offset_s=msg.get("offset_s"),
+                                 rtt_s=msg.get("rtt_s"))
 
     def _adone(self, msg):
         plane = self.agent_plane
@@ -1136,6 +1138,51 @@ class FleetAgentServer(Server):
                     "error": "this fleet does not accept remote agents"}
         return plane.agent_done(agent=msg.get("agent"),
                                 error=msg.get("error"))
+
+
+class SinkServer(Server):
+    """The fleet host's JOURNAL SINK tenant (telemetry/sink.py): one
+    more server published on the fleet's shared listener, under its OWN
+    secret (a journal shipper must not be able to lease agents or speak
+    any experiment's control plane). A single verb:
+
+    - ``JSINK``: a batch of journal events from one SOURCE (a fleet-
+      attached tenant or a remote agent), each stamped with the source's
+      monotonic ``sid`` event id, plus an optional metric-counter
+      snapshot for fleet-side federation. The reply acks the highest
+      sid the sink now holds — at-least-once shipping with sink-side
+      dedup makes delivery exactly-once per event id.
+
+    Batches land on this tenant's ordinary dispatch pool, so journal
+    ingestion is isolated from every experiment's control traffic and a
+    full sink queue sheds frames (per-tenant backpressure) — which the
+    shipper treats as sink death and degrades to its local journal.
+    The handler delegates to the attached ``telemetry.sink.JournalSink``;
+    msg-key reads stay HERE so the rpcconf checker sees the wire
+    contract at the handler."""
+
+    def __init__(self, secret: Optional[str] = None):
+        # The sink service (maggy_tpu.telemetry.sink.JournalSink),
+        # attached by the fleet. None rejects JSINK.
+        self.sink = None
+        super().__init__(1, secret)
+
+    def attach_sink(self, sink) -> None:
+        self.sink = sink
+
+    def _register_handlers(self) -> None:
+        super()._register_handlers()
+        self._handlers.update(JSINK=self._jsink)
+
+    def _jsink(self, msg):
+        sink = self.sink
+        if sink is None:
+            return {"type": "ERR",
+                    "error": "this fleet has no journal sink attached"}
+        return sink.ingest(source=msg.get("source"),
+                           events=msg.get("events"),
+                           counters=msg.get("counters"),
+                           client_t=msg.get("client_t"))
 
 
 class OptimizationServer(Server):

@@ -152,7 +152,8 @@ def _cmd_start(args) -> int:
                   max_queued=args.max_queued,
                   preempt_grace_s=args.preempt_grace,
                   max_agents=args.max_agents,
-                  bind_host=args.bind_host)
+                  bind_host=args.bind_host,
+                  sink=False if args.no_sink else None)
     spool = fleet.home_dir + "/queue"
     env.mkdir(spool)
     handles: Dict[str, Any] = {}
@@ -225,9 +226,11 @@ def _cmd_agent(args) -> int:
 
 def _cmd_soak(args) -> int:
     from maggy_tpu.fleet.soak import (run_agent_soak, run_fleet_soak,
-                                      run_slow_tenant_soak)
+                                      run_sink_soak, run_slow_tenant_soak)
 
-    if args.agent:
+    if args.sink:
+        report = run_sink_soak(seed=args.seed, lock_witness=True)
+    elif args.agent:
         report = run_agent_soak(seed=args.seed, lock_witness=True)
     elif args.slow_tenant:
         # Witness on by default, like the chaos CLI's soaks: the
@@ -284,6 +287,12 @@ def main(argv=None) -> int:
                     help="address the shared listener binds (default "
                          "loopback; set 0.0.0.0 for cross-host agents — "
                          "the ticket then advertises this host's IP)")
+    ps.add_argument("--no-sink", action="store_true",
+                    help="disable the fleet journal sink (telemetry "
+                         "fan-in into <home>/journal/): tenants with "
+                         "config.sink then journal locally, agents keep "
+                         "agent.jsonl private (default: sink on whenever "
+                         "fleet telemetry is)")
 
     pa = sub.add_parser(
         "agent", help="run a remote fleet-agent daemon")
@@ -345,6 +354,13 @@ def main(argv=None) -> int:
                          "(lease revoked, trial requeued exactly once) "
                          "is checked from the journals (run under the "
                          "lock-order witness)")
+    pk.add_argument("--sink", action="store_true",
+                    help="run the journal-sink soak instead: the fleet's "
+                         "sink tenant is killed mid-soak and restarted — "
+                         "invariant 12 (degrade to local journals, "
+                         "re-ship on reconnect, zero lost / duplicate "
+                         "events, zero experiment failures), under the "
+                         "lock-order witness")
     pk.add_argument("--slow-tenant", action="store_true",
                     help="run the slow-tenant isolation soak instead: one "
                          "tenant's handlers artificially delayed, other "

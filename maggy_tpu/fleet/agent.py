@@ -91,9 +91,15 @@ def train_fn_path(fn) -> Optional[str]:
 
 
 def write_fleet_ticket(env, path: str, host: str, port: int, secret: str,
-                       fleet: str, max_agents: int) -> Dict[str, Any]:
+                       fleet: str, max_agents: int,
+                       sink: Optional[str] = None) -> Dict[str, Any]:
     ticket = {"host": host, "port": int(port), "secret": secret,
               "fleet": fleet, "max_agents": int(max_agents)}
+    if sink:
+        # The journal-sink tenant's secret (telemetry/sink.py): agents
+        # ship their own journals + counters to the fleet through it.
+        # Absent for sink-less fleets — agents then journal locally only.
+        ticket["sink"] = sink
     env.dump(json.dumps(ticket, indent=2), path)
     return ticket
 
@@ -217,11 +223,14 @@ class AgentPlane:
         advertise = host
         if advertise in ("0.0.0.0", "", "::"):
             advertise = self.fleet.env.get_ip_address()
+        sink_server = getattr(self.fleet, "sink_server", None)
         self.ticket = write_fleet_ticket(
             self.fleet.env,
             self.fleet.home_dir + "/" + AGENT_TICKET_NAME,
             advertise, port, self.server.secret_hex, self.fleet.name,
-            self.max_agents)
+            self.max_agents,
+            sink=sink_server.secret_hex if sink_server is not None
+            else None)
         return self
 
     def stop(self) -> None:
@@ -274,15 +283,32 @@ class AgentPlane:
         self._event(rec, "join", host=rec.host, chips=rec.chips,
                     process_index=rec.process_index)
         thread.start()
-        # rpc-ok: AJOIN reply literal, not a request producer — poll_s/liveness_s are consumed by the agent CLIENT (FleetAgent.join), a direction the checker does not model
+        # rpc-ok: AJOIN reply literal, not a request producer — poll_s/liveness_s/server_t are consumed by the agent CLIENT (FleetAgent.join), a direction the checker does not model
         return {"type": "AJOIN", "agent": agent_id,
-                "poll_s": self.poll_s, "liveness_s": self.liveness_s}
+                "poll_s": self.poll_s, "liveness_s": self.liveness_s,
+                "server_t": time.time()}
 
-    def agent_lease(self, agent) -> Dict[str, Any]:
+    def agent_lease(self, agent, offset_s=None,
+                    rtt_s=None) -> Dict[str, Any]:
         """ALEASE handler body: idle heartbeat + lease delivery. A
         retried ALEASE (lost reply) re-serves the same undelivered ABIND
         — at-least-once delivery, idempotent on the agent side because
-        the lease names one (exp, partition) pair."""
+        the lease names one (exp, partition) pair.
+
+        Clock piggyback: every reply carries ``server_t`` (this host's
+        wall clock at reply build) so the agent's RTT-bounded offset
+        estimator (telemetry.sink.ClockOffsetEstimator) gets a sample
+        per poll; the agent reports its current estimate back on a
+        cadence via ``offset_s``/``rtt_s``, journaled here as a
+        ``clock_offset`` event per agent — the unified trace's
+        cross-process time base."""
+        if offset_s is not None:
+            telem = self.telemetry
+            if telem is not None:
+                telem.event("clock_offset", agent=agent,
+                            offset_s=float(offset_s),
+                            rtt_s=float(rtt_s) if rtt_s is not None
+                            else None)
         lease = None
         with self._lock:
             rec = self._agents.get(agent)
@@ -311,8 +337,9 @@ class AgentPlane:
             self._event_raw(agent, "lease", exp=lease.get("exp"),
                             pid=lease.get("partition_id"),
                             abind_ms=abind_ms)
+            lease["server_t"] = time.time()
             return lease
-        return {"type": "OK"}
+        return {"type": "OK", "server_t": time.time()}
 
     def agent_done(self, agent, error) -> Dict[str, Any]:
         with self._lock:
@@ -562,6 +589,8 @@ class FleetAgent:
                  advertise_host: str = "127.0.0.1",
                  obs_port: Optional[int] = None, home: Optional[str] = None,
                  profile: bool = False):
+        from maggy_tpu.telemetry.sink import ClockOffsetEstimator
+
         self.addr = (ticket["host"], int(ticket["port"]))
         self.secret = ticket["secret"]
         self.chips = int(chips)
@@ -581,6 +610,16 @@ class FleetAgent:
         self._home = home
         self._telemetry = None
         self._obs_registration = None
+        #: Journal-sink shipping (telemetry/sink.py): with the ticket's
+        #: ``sink`` secret present, this agent's journal + counters ship
+        #: to the fleet host over the shared socket.
+        self._sink_secret = ticket.get("sink")
+        #: RTT-bounded clock-offset estimate vs the fleet host, fed by
+        #: the server_t every AJOIN/ALEASE reply carries; reported back
+        #: on a cadence and journaled fleet-side per agent.
+        self.clock = ClockOffsetEstimator()
+        self._offset_reported: Optional[float] = None
+        self._offset_report_t = 0.0
 
     @classmethod
     def from_ticket(cls, path: str, wait_s: float = 0.0,
@@ -590,6 +629,7 @@ class FleetAgent:
     # ------------------------------------------------------------- lifecycle
 
     def join(self) -> str:
+        t_send = time.time()
         resp = self._channel.call({
             "type": "AJOIN", "host": self.host, "chips": self.chips,
             "process_index": self.process_index,
@@ -599,6 +639,7 @@ class FleetAgent:
         if resp.get("type") != "AJOIN":
             raise RuntimeError("AJOIN rejected: {}".format(
                 resp.get("error", resp)))
+        self.clock.sample(t_send, resp.get("server_t"), time.time())
         self.agent_id = resp["agent"]
         self.poll_s = float(resp.get("poll_s") or DEFAULT_POLL_S)
         self.liveness_s = float(resp.get("liveness_s")
@@ -616,11 +657,11 @@ class FleetAgent:
                 "last_error": self.last_error}
 
     def _start_obs(self) -> None:
-        if self._obs_port is None:
+        if self._obs_port is None and not self._sink_secret:
             return
         from maggy_tpu.core.environment import EnvSing
         from maggy_tpu.telemetry import Telemetry
-        from maggy_tpu.telemetry import obs as obs_mod
+        from maggy_tpu.telemetry.sink import SinkBinding
 
         home = self._home
         if home is None:
@@ -628,19 +669,44 @@ class FleetAgent:
 
             home = tempfile.mkdtemp(prefix="maggy_agent_")
         self._home = home
+        # With the ticket's sink secret, the agent's journal ships to
+        # the fleet host (source = this agent's id) and agent.jsonl
+        # becomes the degraded-mode fallback; without it, agent.jsonl is
+        # the journal, exactly as before.
+        sink = SinkBinding(self.addr, self._sink_secret) \
+            if self._sink_secret else None
         self._telemetry = Telemetry(
             env=EnvSing.get_instance(),
-            journal_path=home + "/agent.jsonl", enabled=True)
-        self._obs_registration = obs_mod.ObsRegistration(
-            key="agent:{}".format(self.agent_id),
-            labels={"experiment": "fleet-agent",
-                    "run": self.agent_id or "agent"},
-            telemetry=self._telemetry, status_fn=self.status)
-        server = obs_mod.register(self._obs_registration,
-                                  port=self._obs_port)
-        self._telemetry.event("obs_started", host=server.address[0],
-                              port=server.address[1],
-                              experiment=self.agent_id)
+            journal_path=home + "/agent.jsonl", enabled=True,
+            sink=sink, sink_source=self.agent_id or "agent")
+        if self._obs_port is not None:
+            from maggy_tpu.telemetry import obs as obs_mod
+
+            self._obs_registration = obs_mod.ObsRegistration(
+                key="agent:{}".format(self.agent_id),
+                labels={"experiment": "fleet-agent",
+                        "run": self.agent_id or "agent"},
+                telemetry=self._telemetry, status_fn=self.status)
+            server = obs_mod.register(self._obs_registration,
+                                      port=self._obs_port)
+            self._telemetry.event("obs_started", host=server.address[0],
+                                  port=server.address[1],
+                                  experiment=self.agent_id)
+
+    def _offset_to_report(self):
+        """The (offset_s, rtt_s) pair to piggyback on the next ALEASE —
+        when the estimate changed since the last report or the report
+        cadence elapsed; None otherwise (most polls carry nothing)."""
+        from maggy_tpu.telemetry.sink import OFFSET_REPORT_INTERVAL_S
+
+        if self.clock.offset_s is None:
+            return None
+        changed = self._offset_reported != self.clock.offset_s
+        due = (time.monotonic() - self._offset_report_t
+               >= OFFSET_REPORT_INTERVAL_S)
+        if changed or due:
+            return (self.clock.offset_s, self.clock.rtt_s)
+        return None
 
     def _stop_obs(self) -> None:
         if self._obs_registration is not None:
@@ -670,9 +736,14 @@ class FleetAgent:
         fail_since: Optional[float] = None
         try:
             while not self._stop.is_set():
+                req = {"type": "ALEASE", "agent": self.agent_id}
+                report = self._offset_to_report()
+                if report is not None:
+                    req["offset_s"] = report[0]
+                    req["rtt_s"] = report[1]
+                t_send = time.time()
                 try:
-                    resp = self._channel.call(
-                        {"type": "ALEASE", "agent": self.agent_id})
+                    resp = self._channel.call(req)
                     fail_since = None
                 except (ConnectionError, OSError):
                     now = time.monotonic()
@@ -681,15 +752,39 @@ class FleetAgent:
                         raise
                     time.sleep(min(1.0, self.poll_s * 2))
                     continue
+                if report is not None:
+                    self._offset_reported = report[0]
+                    self._offset_report_t = time.monotonic()
+                if self.clock.sample(t_send, resp.get("server_t"),
+                                      time.time()) \
+                        and self._telemetry is not None:
+                    self._telemetry.event("clock_offset",
+                                          agent=self.agent_id,
+                                          offset_s=self.clock.offset_s,
+                                          rtt_s=self.clock.rtt_s)
                 rtype = resp.get("type")
                 if rtype == "AGSTOP":
                     break
                 if rtype == "ABIND":
                     idle_since = time.monotonic()
+                    if self._telemetry is not None:
+                        # Agent-side span of the lease: the unified
+                        # trace renders lease..done as this agent's
+                        # execution slice, the middle anchor of the
+                        # ABIND -> execution -> FINAL flow arrow.
+                        self._telemetry.event(
+                            "agent", phase="lease", agent=self.agent_id,
+                            exp=resp.get("exp"),
+                            pid=resp.get("partition_id"))
                     error = self._serve(resp)
                     self.leases_served += 1
                     self.last_error = error
                     if self._telemetry is not None:
+                        self._telemetry.event(
+                            "agent", phase="done", agent=self.agent_id,
+                            exp=resp.get("exp"),
+                            pid=resp.get("partition_id"),
+                            error=bool(error))
                         self._telemetry.metrics.counter(
                             "agent.leases").inc()
                         if error:

@@ -973,7 +973,8 @@ class Fleet:
                  dispatch_pool: Optional[bool] = None,
                  max_agents: int = 0,
                  bind_host: str = "127.0.0.1",
-                 agent_liveness_s: Optional[float] = None):
+                 agent_liveness_s: Optional[float] = None,
+                 sink: Optional[bool] = None):
         if pool != "thread":
             raise ValueError(
                 "fleet pools are in-process ('thread'): experiments are "
@@ -1013,6 +1014,14 @@ class Fleet:
         self.bind_host = bind_host
         self._agent_liveness_s = agent_liveness_s
         self.agent_plane = None
+        # Fleet-wide telemetry fan-in (maggy_tpu.telemetry.sink): the
+        # journal-sink service demuxing tenant/agent journals into
+        # <home>/journal/ per-source files, plus its SinkServer tenant
+        # on the shared listener. Default: on whenever fleet telemetry
+        # is (tenants still opt IN per experiment via config.sink).
+        self.sink_enabled = bool(telemetry) if sink is None else bool(sink)
+        self.sink = None
+        self.sink_server = None
         self._pool_thread: Optional[threading.Thread] = None
         self._tick_thread: Optional[threading.Thread] = None
         self._started = False
@@ -1042,13 +1051,26 @@ class Fleet:
             self._started = True
         self.telemetry.event("fleet", phase="start", name=self.name,
                              runners=self.num_runners, pool="thread")
+        if self.sink_enabled:
+            from maggy_tpu.core.rpc import SinkServer
+            from maggy_tpu.telemetry.sink import SINK_DIR_NAME, JournalSink
+
+            self.sink = JournalSink(
+                self.env, self.home_dir + "/" + SINK_DIR_NAME,
+                telemetry=self.telemetry)
+            self.sink_server = SinkServer()
+            self.sink_server.telemetry = self.telemetry
+            self.sink_server.attach_sink(self.sink)
+            self.shared_server.attach(self.sink_server,
+                                      host=self.bind_host)
         if self._obs_port is not None and self.telemetry.enabled:
             from maggy_tpu.telemetry import obs as obs_mod
 
             self._obs_registration = obs_mod.ObsRegistration(
                 key="fleet:{}".format(self.name),
                 labels={"experiment": self.name, "run": "fleet"},
-                telemetry=self.telemetry, status_fn=self.status)
+                telemetry=self.telemetry, status_fn=self.status,
+                snapshots_fn=self._federated_metrics)
             server = obs_mod.register(self._obs_registration,
                                       port=self._obs_port,
                                       host=self._obs_host)
@@ -1123,6 +1145,10 @@ class Fleet:
             if t is not None:
                 t.join(timeout=5)
         self.shared_server.stop()
+        if self.sink is not None:
+            # After the listener: no more JSINK frames can land, so the
+            # sink can seal every per-source journal cleanly.
+            self.sink.stop()
         if self._obs_registration is not None:
             from maggy_tpu.telemetry import obs as obs_mod
 
@@ -1131,6 +1157,44 @@ class Fleet:
         self.telemetry.event("fleet", phase="stop")
         self._dump_status()
         self.telemetry.close()
+
+    # ------------------------------------------------------------ sink plane
+
+    def sink_binding(self):
+        """Where this fleet's journal shippers dial (telemetry.sink.
+        SinkBinding), or None when the sink is off / not started."""
+        if self.sink_server is None or self.shared_server.addr is None:
+            return None
+        from maggy_tpu.telemetry.sink import SinkBinding
+
+        return SinkBinding(self.shared_server.addr,
+                           self.sink_server.secret_hex)
+
+    def kill_sink(self) -> bool:
+        """Chaos/test hook (invariant 12): detach the sink tenant from
+        the shared listener — in-flight and future JSINK frames fail
+        authentication and shippers degrade to their local journals.
+        The sink service itself (writers, dedup state) stays intact for
+        ``restart_sink``."""
+        if self.sink_server is None:
+            return False
+        self.shared_server.detach(self.sink_server)
+        return True
+
+    def restart_sink(self) -> bool:
+        """Re-attach the sink tenant under the SAME secret: degraded
+        shippers reconnect on their next cycle and re-ship their spooled
+        suffix (the sink's sid dedup absorbs any overlap)."""
+        if self.sink_server is None:
+            return False
+        self.shared_server.attach(self.sink_server, host=self.bind_host)
+        return True
+
+    def _federated_metrics(self):
+        """Per-source shipped counter snapshots for the fleet's
+        /metrics registration (obs.ObsRegistration.snapshots_fn)."""
+        return self.sink.federated_snapshots() if self.sink is not None \
+            else []
 
     def __enter__(self) -> "Fleet":
         return self.start()
@@ -1247,6 +1311,8 @@ class Fleet:
                 "stopped": self._stopped,
                 "max_agents": self.max_agents,
                 "agents": plane.snapshot() if plane is not None else [],
+                "sink": self.sink.snapshot()
+                if self.sink is not None else {},
                 **snap}
 
     def _dump_status(self) -> None:
@@ -1293,6 +1359,14 @@ def replay_fleet_journal(path: str, env=None,
     agent_leases: Dict[str, int] = {}
     abind_ms: List[float] = []
     agent_lost_leases = 0
+    # Journal-sink ingest records (jsink) + per-agent clock offsets —
+    # the telemetry fan-in plane's replayable numbers.
+    sink_batches = 0
+    sink_events = 0
+    sink_dup = 0
+    sink_lag_ms: List[float] = []
+    sink_sources_seen: set = set()
+    clock_offsets: Dict[str, Dict[str, Any]] = {}
 
     def exp(name: str) -> Dict[str, Any]:
         return exps.setdefault(name, {
@@ -1347,6 +1421,21 @@ def replay_fleet_journal(path: str, env=None,
                 agent_leases[aid] = agent_leases.get(aid, 0) + 1
                 if ev.get("abind_ms") is not None:
                     abind_ms.append(float(ev["abind_ms"]))
+        elif kind == "jsink":
+            sink_batches += 1
+            sink_events += int(ev.get("n") or 0)
+            sink_dup += int(ev.get("dup") or 0)
+            if ev.get("source"):
+                sink_sources_seen.add(str(ev["source"]))
+            if ev.get("lag_ms") is not None:
+                sink_lag_ms.append(float(ev["lag_ms"]))
+        elif kind == "clock_offset":
+            if ev.get("agent"):
+                clock_offsets[str(ev["agent"])] = {
+                    "offset_s": ev.get("offset_s"),
+                    "rtt_s": ev.get("rtt_s"), "t": t,
+                    "reports": clock_offsets.get(
+                        str(ev["agent"]), {}).get("reports", 0) + 1}
         elif kind == "preempt":
             preempts += 1
             exp(ev["exp"])["preemptions"] += 1
@@ -1422,6 +1511,17 @@ def replay_fleet_journal(path: str, env=None,
             "per_agent_leases": dict(sorted(agent_leases.items())),
             "abind_ms": _dist_stats(abind_ms),
         },
+        # Journal-sink ingest (empty/zero when no tenant/agent shipped).
+        "sink": {
+            "batches": sink_batches,
+            "events": sink_events,
+            "dup": sink_dup,
+            "sources": len(sink_sources_seen),
+            "lag_ms": _dist_stats(sink_lag_ms),
+        },
+        # Last reported clock offset per agent — the unified trace's
+        # cross-process time base.
+        "clock_offsets": clock_offsets,
         "share": share,
         "expected_share": expected,
         "share_error": share_error,

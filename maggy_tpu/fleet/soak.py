@@ -197,11 +197,14 @@ def resident_train_fn(lr, units, reporter=None, ctx=None):
 
 
 def _scale_config(name: str, trials: int, base_dir: str, seed: int,
-                  hb_interval: float = 0.25, telemetry: bool = False):
-    """Config for a cheap churn tenant: per-experiment telemetry and the
-    health engine OFF — the fleet journal carries every scheduling fact
-    the gates replay, and 500 concurrent journals/flushers/engines would
-    measure journal fan-out, not the scheduler."""
+                  hb_interval: float = 0.25, telemetry: bool = False,
+                  sink: bool = False):
+    """Config for a cheap churn tenant: the health engine OFF and —
+    without the sink — per-experiment telemetry off too (500 concurrent
+    journals/flushers would measure journal fan-out, not the scheduler).
+    ``sink=True`` re-enables telemetry THROUGH the fleet's journal sink:
+    one process-wide shipper thread and per-source files under the fleet
+    home, no per-tenant flusher — telemetry at churn scale for free."""
     from maggy_tpu import OptimizationConfig, Searchspace
 
     return OptimizationConfig(
@@ -210,7 +213,8 @@ def _scale_config(name: str, trials: int, base_dir: str, seed: int,
                                 units=("INTEGER", [8, 64])),
         direction="max", hb_interval=hb_interval, hb_loss_timeout=10.0,
         seed=seed, es_policy="none", experiment_dir=base_dir,
-        telemetry=telemetry, health=False, verbose=False)
+        telemetry=telemetry or sink, sink=sink, health=False,
+        verbose=False)
 
 
 def run_scale_churn(experiments: int = 520, runners: int = 8,
@@ -220,8 +224,8 @@ def run_scale_churn(experiments: int = 520, runners: int = 8,
                     max_queued: Optional[int] = None,
                     result_timeout_s: float = 900.0,
                     min_decisions_per_s: float = 10.0,
-                    admission_p99_bound_s: Optional[float] = None
-                    ) -> Dict[str, Any]:
+                    admission_p99_bound_s: Optional[float] = None,
+                    sink: bool = False) -> Dict[str, Any]:
     """Churn soak: hammer ONE fleet with ``experiments`` concurrent cheap
     tenants — most via ``lagom_submit``, a slice via the spool path the
     CLI host uses — and gate the control plane's replayed numbers:
@@ -261,7 +265,8 @@ def run_scale_churn(experiments: int = 520, runners: int = 8,
                     "config": {"num_trials": trials_per_exp,
                                "optimizer": "randomsearch",
                                "direction": "max", "seed": seed + i,
-                               "es_policy": "none", "telemetry": False,
+                               "es_policy": "none", "telemetry": sink,
+                               "sink": sink,
                                "health": False, "hb_interval": 0.25,
                                "searchspace": {
                                    "lr": ["DOUBLE", [0.0, 0.2]],
@@ -275,7 +280,8 @@ def run_scale_churn(experiments: int = 520, runners: int = 8,
             try:
                 handles[name] = experiment.lagom_submit(
                     scale_train_fn,
-                    _scale_config(name, trials_per_exp, base_dir, seed + i),
+                    _scale_config(name, trials_per_exp, base_dir, seed + i,
+                                  sink=sink),
                     fleet=fleet, block=False, name=name)
             except FleetSaturated:
                 shed += 1  # expected under a max_queued bound
@@ -342,6 +348,9 @@ def run_scale_churn(experiments: int = 520, runners: int = 8,
         "decisions_per_s": replay["decisions_per_s"],
         "queue_wait_ms": replay["queue_wait_ms"],
         "preemptions": replay["preemptions"],
+        # Journal-sink ingest (zero when the churn ran telemetry-off).
+        "telemetry_sink": sink,
+        "sink": replay.get("sink"),
     }
     return {"ok": not violations, "violations": violations,
             "detail": detail, "journal": journal, "base_dir": base_dir}
@@ -811,6 +820,230 @@ def run_agent_soak(agents: int = 2, trials: int = 6, seed: int = 7,
             "witness": witness_block, "base_dir": base_dir}
 
 
+def sink_train_fn(lr, units, reporter=None):
+    """Churn-shaped trial, stretched: enough broadcast steps that the
+    sink soak's kill/recover window reliably lands while trials (and
+    their journal events) are still flowing."""
+    import time as _time
+
+    value = 1.0 / (1.0 + abs(lr - 0.1) + units / 1e4)
+    for step in range(4):
+        if reporter is not None:
+            reporter.broadcast(value * (step + 1), step=step)
+        _time.sleep(0.08)
+    return {"metric": value}
+
+
+def run_sink_soak(tenants: int = 3, trials: int = 6, seed: int = 7,
+                  base_dir: Optional[str] = None,
+                  result_timeout_s: float = 240.0,
+                  phase_timeout_s: float = 30.0,
+                  lock_witness: Optional[bool] = None) -> Dict[str, Any]:
+    """Chaos invariant 12 — the journal sink degrades, never dominates:
+    tenants run with sink-routed telemetry (``config.sink``) while the
+    soak KILLS the sink mid-run (``kill_sink``: the sink tenant detaches
+    from the shared listener, exactly what a crashed/partitioned sink
+    looks like to shippers) and restarts it once a shipper has provably
+    degraded. Checked offline over the artifacts:
+
+    - zero experiment failures: every tenant completes its schedule and
+      its merged journal passes the standard trial invariants;
+    - zero lost events: per source, the union of the sink's per-source
+      segments and the surviving local journal covers every event id
+      ``1..max`` (the degraded window re-shipped / fell back locally);
+    - zero duplicates: the merged (sid-deduped) stream holds each event
+      id exactly once across the fallback seam;
+    - the seam is real: ``sink_degraded`` AND ``sink_recovered`` events
+      exist, and the degraded source's local fallback journal exists.
+
+    A soak-owned PROBE journal records on a steady cadence through the
+    whole window, so the degrade/recover/re-ship path is exercised
+    deterministically even when the tenants' own schedules drain early.
+    Runs under the lock-order witness like every chaos soak."""
+    from maggy_tpu import experiment
+    from maggy_tpu.analysis import witness as _witness
+    from maggy_tpu.chaos.harness import check_invariants
+    from maggy_tpu.core.environment import EnvSing
+    from maggy_tpu.telemetry import JOURNAL_NAME, Telemetry, read_events
+    from maggy_tpu.telemetry.sink import (check_exactly_once,
+                                          merge_source_events,
+                                          sanitize_source)
+
+    wit = None
+    wit_installed_here = False
+    wit_pre_violations = 0
+    if lock_witness or (lock_witness is None and _witness.enabled_by_env()):
+        wit_installed_here = _witness.active_witness() is None
+        wit = _witness.install()
+        wit_pre_violations = len(wit.violations)
+
+    base_dir = base_dir or tempfile.mkdtemp(prefix="maggy_sink_soak_")
+    env = EnvSing.get_instance()
+    t0 = time.time()
+    fleet = Fleet(runners=2, home_dir=os.path.join(base_dir, "fleet"),
+                  preempt_grace_s=5.0)
+    violations: List[str] = []
+    handles: Dict[str, Any] = {}
+    probe: Optional[Telemetry] = None
+    killed_t = None
+    recovered_seen = False
+    expected: Dict[str, int] = {}
+    exp_dirs: Dict[str, str] = {}
+    try:
+        with fleet:
+            probe = Telemetry(
+                env=env, journal_path=os.path.join(base_dir,
+                                                   "probe_local.jsonl"),
+                enabled=True, sink=fleet.sink_binding(),
+                sink_source="probe")
+            for i in range(tenants):
+                name = "sink{:02d}".format(i)
+                handles[name] = experiment.lagom_submit(
+                    sink_train_fn,
+                    _scale_config(name, trials, base_dir, seed + i,
+                                  hb_interval=0.05, sink=True),
+                    fleet=fleet, block=False, name=name)
+
+            def _tick(n: int) -> None:
+                probe.event("runner_stats", partition=0, probe=n)
+
+            # Phase 1: the sink must be provably ingesting.
+            deadline = time.monotonic() + phase_timeout_s
+            n = 0
+            while time.monotonic() < deadline:
+                _tick(n)
+                n += 1
+                snap = fleet.sink.snapshot()
+                if any(s["ingested"] > 0 for s in snap.values()):
+                    break
+                time.sleep(0.1)
+            else:
+                violations.append(
+                    "sink never ingested a batch within {:.0f}s — the "
+                    "kill had nothing to degrade".format(phase_timeout_s))
+            # Phase 2: kill the sink; a shipper must degrade.
+            fleet.telemetry.event("chaos", kind="kill_sink")
+            fleet.kill_sink()
+            killed_t = time.time()
+            deadline = time.monotonic() + phase_timeout_s
+            while time.monotonic() < deadline:
+                _tick(n)
+                n += 1
+                if probe.journal is not None and probe.journal.degraded:
+                    break
+                time.sleep(0.1)
+            else:
+                violations.append(
+                    "no shipper degraded within {:.0f}s of the sink "
+                    "kill".format(phase_timeout_s))
+            # Phase 3: restart; the degraded shipper must recover and
+            # re-ship its spool.
+            fleet.restart_sink()
+            deadline = time.monotonic() + phase_timeout_s
+            while time.monotonic() < deadline:
+                _tick(n)
+                n += 1
+                if probe.journal is not None \
+                        and not probe.journal.degraded:
+                    recovered_seen = True
+                    break
+                time.sleep(0.1)
+            if not recovered_seen:
+                violations.append(
+                    "shipper did not recover within {:.0f}s of the sink "
+                    "restart".format(phase_timeout_s))
+            for name, handle in sorted(handles.items()):
+                try:
+                    result = handle.result(timeout=result_timeout_s)
+                    if result.get("num_trials") != trials:
+                        violations.append(
+                            "{} finished {} of {} trials".format(
+                                name, result.get("num_trials"), trials))
+                except BaseException as e:  # noqa: BLE001 - a failed tenant IS the invariant failure
+                    violations.append(
+                        "experiment {} failed after the sink kill: "
+                        "{!r}".format(name, e))
+            probe.close()
+            expected["probe"] = probe.journal.max_sid() \
+                if probe.journal is not None else 0
+            for name, handle in handles.items():
+                drv = handle.entry.driver
+                if drv is None:
+                    continue
+                exp_dirs[name] = drv.exp_dir
+                max_sid = getattr(drv.telemetry.journal, "max_sid", None)
+                if max_sid is not None:
+                    expected[name] = max_sid()
+    finally:
+        if wit is not None and wit_installed_here \
+                and not _witness.enabled_by_env():
+            _witness.uninstall()
+    wall_s = time.time() - t0
+
+    # Offline exactly-once check per source over sink segments + the
+    # surviving local journals.
+    sink_dir = os.path.join(fleet.home_dir, "journal")
+    degraded_events = 0
+    recovered_events = 0
+    per_source: Dict[str, Dict[str, Any]] = {}
+    local_paths = {"probe": os.path.join(base_dir, "probe_local.jsonl")}
+    for name, exp_dir in exp_dirs.items():
+        local_paths[name] = os.path.join(exp_dir, JOURNAL_NAME)
+    for source, want in sorted(expected.items()):
+        spath = os.path.join(sink_dir,
+                             sanitize_source(source) + ".jsonl")
+        shipped = read_events(spath) if os.path.exists(spath) else None
+        lpath = local_paths.get(source)
+        local = read_events(lpath) \
+            if lpath and os.path.exists(lpath) else None
+        merged = merge_source_events(shipped, local)
+        source_violations = check_exactly_once(merged,
+                                               expected_max_sid=want)
+        degraded_events += sum(1 for e in merged
+                               if e.get("ev") == "sink_degraded")
+        recovered_events += sum(1 for e in merged
+                                if e.get("ev") == "sink_recovered")
+        if source != "probe":
+            report = check_invariants(merged, stall_flag_bound_s=None)
+            source_violations.extend(report["violations"])
+        per_source[source] = {
+            "expected": want,
+            "sink_events": len(shipped) if shipped is not None else 0,
+            "local_events": len(local) if local is not None else 0,
+            "merged": len(merged),
+            "violations": source_violations,
+        }
+        violations.extend("{}: {}".format(source, v)
+                          for v in source_violations)
+    if killed_t is not None and degraded_events < 1:
+        violations.append("sink killed but no sink_degraded event "
+                          "survives in any merged journal")
+    if recovered_seen and recovered_events < 1:
+        violations.append("shipper recovered but no sink_recovered "
+                          "event survives in any merged journal")
+    witness_block = None
+    if wit is not None:
+        new_violations = wit.violations[wit_pre_violations:]
+        witness_block = {"edges": len(wit.edges),
+                         "violations": len(new_violations)}
+        for v in new_violations:
+            violations.append("lock-order witness: {}".format(v))
+    detail = {
+        "tenants": tenants,
+        "killed_t": killed_t,
+        "degraded_events": degraded_events,
+        "recovered_events": recovered_events,
+        "per_source": per_source,
+        "wall_s": round(wall_s, 1),
+        "witness": witness_block,
+    }
+    return {"ok": not violations, "violations": violations,
+            "detail": detail, "sink_dir": sink_dir,
+            "fleet_journal": os.path.join(fleet.home_dir,
+                                          FLEET_JOURNAL_NAME),
+            "witness": witness_block, "base_dir": base_dir}
+
+
 def run_remote_scale_soak(experiments: int = 40, agents: int = 4,
                           runners: int = 2, max_active: int = 8,
                           trials_per_exp: int = 1, seed: int = 7,
@@ -821,11 +1054,15 @@ def run_remote_scale_soak(experiments: int = 40, agents: int = 4,
     of sockets"): the PR-11 churn driven by REAL agent processes over
     sockets — every agent is a separate OS process dialing the shared
     listener, every lease a full AJOIN/ABIND/REG/.../ADONE round trip.
-    Gates: every tenant completes, every agent joins, and remote leases
+    Gates: every tenant completes, every agent joins, remote leases
     actually happened (the churn must not quietly drain through the
-    thread runners alone). Records ``detail.remote``: agent join
-    latency p50/p95 (process spawn -> fleet journal join), ABIND lease
-    round-trip p50/p95, and churn completion."""
+    thread runners alone), and — with the journal sink on — the run
+    yields ONE ``--unified`` Perfetto trace: driver track, one process
+    group per agent, ABIND->execution->FINAL flow arrows, event order
+    consistent with the journaled clock offsets. Records
+    ``detail.remote``: agent join latency p50/p95 (process spawn ->
+    fleet journal join), ABIND lease round-trip p50/p95, churn
+    completion, and the unified-trace block."""
     import signal
 
     from maggy_tpu import experiment
@@ -857,7 +1094,7 @@ def run_remote_scale_soak(experiments: int = 40, agents: int = 4,
                     handles[name] = experiment.lagom_submit(
                         scale_train_fn,
                         _scale_config(name, trials_per_exp, base_dir,
-                                      seed + i),
+                                      seed + i, sink=True),
                         fleet=fleet, block=False, name=name)
                 except FleetSaturated:
                     pass
@@ -914,6 +1151,53 @@ def run_remote_scale_soak(experiments: int = 40, agents: int = 4,
         violations.append(
             "no lease was ever granted to a remote agent — the churn "
             "drained entirely through thread runners")
+    # The unified trace: fleet journal + sink segments merged with any
+    # surviving local journals, clock-corrected, flow-arrowed — the
+    # artifact the acceptance gate inspects.
+    unified: Dict[str, Any] = {}
+    try:
+        from maggy_tpu.telemetry.sink import (SINK_DIR_NAME,
+                                              merge_source_events,
+                                              read_sink_dir,
+                                              sanitize_source)
+        from maggy_tpu.telemetry.trace import (build_unified_trace,
+                                               validate_trace)
+
+        fleet_events = read_events(journal)
+        sink_map = read_sink_dir(os.path.join(fleet.home_dir,
+                                              SINK_DIR_NAME))
+        agent_ids = {str(ev.get("agent")) for ev in fleet_events
+                     if ev.get("ev") == "agent"
+                     and ev.get("phase") == "join" and ev.get("agent")}
+        exp_events: Dict[str, Any] = {}
+        for name in handles:
+            shipped = sink_map.pop(sanitize_source(name), None)
+            if shipped:
+                exp_events[name] = merge_source_events(shipped)
+        agent_journals = {src: evs for src, evs in sink_map.items()
+                          if src in agent_ids}
+        trace = build_unified_trace(fleet_events, exp_events,
+                                    agent_journals=agent_journals)
+        validate_trace(trace)
+        out_path = os.path.join(fleet.home_dir, "unified_trace.json")
+        with open(out_path, "w") as f:
+            json.dump(trace, f)
+        other = trace.get("otherData") or {}
+        unified = {"path": out_path,
+                   "agents": len(other.get("agents") or []),
+                   "flows": other.get("flows", 0),
+                   "clock_offsets": len(other.get("clock_offsets")
+                                        or {})}
+        if unified["agents"] < min(2, agents):
+            violations.append(
+                "unified trace carries {} agent process group(s) "
+                "(expected >= {})".format(unified["agents"],
+                                          min(2, agents)))
+        if unified["flows"] < 1:
+            violations.append(
+                "unified trace carries no ABIND->execution flow arrows")
+    except Exception as e:  # noqa: BLE001 - a broken trace build is a gate failure, not a crash
+        violations.append("unified trace build failed: {!r}".format(e))
     detail = {
         "experiments": len(handles),
         "completed": len(handles) - sum(1 for n in failures
@@ -925,6 +1209,9 @@ def run_remote_scale_soak(experiments: int = 40, agents: int = 4,
         "abind_ms": agents_replay.get("abind_ms"),
         "remote_leases": remote_leases,
         "total_leases": agents_replay.get("leases", 0),
+        "unified": unified,
+        "sink": replay.get("sink"),
+        "clock_offsets": replay.get("clock_offsets"),
         "wall_s": round(wall_s, 1),
         "experiments_per_s": round(len(handles) / wall_s, 2)
         if wall_s > 0 else None,
@@ -938,16 +1225,26 @@ def run_remote_scale_soak(experiments: int = 40, agents: int = 4,
 def run_scale_soak(experiments: int = 520, runners: int = 8,
                    max_active: int = 12, seed: int = 7,
                    base_dir: Optional[str] = None,
-                   churn_kwargs: Optional[Dict[str, Any]] = None
+                   churn_kwargs: Optional[Dict[str, Any]] = None,
+                   sink_ab: bool = True,
+                   sink_throughput_ratio: float = 0.9,
+                   sink_lag_p95_bound_ms: float = 10_000.0
                    ) -> Dict[str, Any]:
     """The full ``bench.py --scale`` scenario, importable for tests:
 
     1. **churn** — ``experiments`` concurrent cheap tenants through one
        fleet (lagom_submit + spool), gating completion, scheduler
        decision throughput, and admission latency p99;
-    2. **fair share** — three weighted residents, gating journal-replayed
+    2. **sink A/B** — the SAME churn with telemetry re-enabled through
+       the fleet's journal sink (``config.sink``): decision throughput
+       must stay within ``sink_throughput_ratio`` (default 10%) of the
+       telemetry-off baseline, admission p99 within the mirrored 10%
+       bound, and the sink's replayed ingest lag p95 under
+       ``sink_lag_p95_bound_ms`` — telemetry at churn scale must be
+       near-free, or the sink is dominating instead of observing;
+    3. **fair share** — three weighted residents, gating journal-replayed
        share error;
-    3. **slow-tenant A/B** — the head-of-line isolation proof: victims'
+    4. **slow-tenant A/B** — the head-of-line isolation proof: victims'
        hand-off p95 with the per-tenant dispatch pools ON must hold the
        isolation bound, and the pool-OFF (pre-fix shared-loop) arm must
        show the inflation the pools remove.
@@ -957,6 +1254,58 @@ def run_scale_soak(experiments: int = 520, runners: int = 8,
         experiments=experiments, runners=runners, max_active=max_active,
         seed=seed, base_dir=os.path.join(base_dir, "churn"),
         **(churn_kwargs or {}))
+    sink_detail = None
+    sink_violations: List[str] = []
+    if sink_ab:
+        churn_sink = run_scale_churn(
+            experiments=experiments, runners=runners,
+            max_active=max_active, seed=seed,
+            base_dir=os.path.join(base_dir, "churn_sink"), sink=True,
+            **(churn_kwargs or {}))
+        sink_violations.extend(churn_sink["violations"])
+        off_rate = churn["detail"].get("decisions_per_s")
+        on_rate = churn_sink["detail"].get("decisions_per_s")
+        rate_ratio = None
+        if off_rate and on_rate:
+            rate_ratio = round(on_rate / off_rate, 3)
+            if rate_ratio < sink_throughput_ratio:
+                sink_violations.append(
+                    "sink-on decision throughput {:.1f}/s is {:.0%} of "
+                    "the telemetry-off baseline {:.1f}/s (floor "
+                    "{:.0%})".format(on_rate, on_rate / off_rate,
+                                     off_rate, sink_throughput_ratio))
+        off_p99 = churn["detail"].get("admission_p99_ms")
+        on_p99 = churn_sink["detail"].get("admission_p99_ms")
+        p99_ratio = None
+        if off_p99 and on_p99:
+            p99_ratio = round(on_p99 / off_p99, 3)
+            # Mirrored 10% bound, with an absolute floor so sub-second
+            # p99s don't fail on scheduler jitter.
+            if on_p99 > off_p99 * (2 - sink_throughput_ratio) + 500.0:
+                sink_violations.append(
+                    "sink-on admission p99 {:.0f} ms exceeds the "
+                    "telemetry-off baseline {:.0f} ms by more than "
+                    "{:.0%}".format(on_p99, off_p99,
+                                    1 - sink_throughput_ratio))
+        sink_replay = churn_sink["detail"].get("sink") or {}
+        lag_p95 = (sink_replay.get("lag_ms") or {}).get("p95_ms")
+        if not sink_replay.get("events"):
+            sink_violations.append(
+                "sink arm ran but the fleet journal carries no jsink "
+                "ingest records — tenants did not ship")
+        elif lag_p95 is not None and lag_p95 > sink_lag_p95_bound_ms:
+            sink_violations.append(
+                "sink ingest lag p95 {:.0f} ms over the {:.0f} ms "
+                "bound".format(lag_p95, sink_lag_p95_bound_ms))
+        sink_detail = {
+            "baseline": {"decisions_per_s": off_rate,
+                         "admission_p99_ms": off_p99},
+            "sink_on": churn_sink["detail"],
+            "decisions_ratio": rate_ratio,
+            "admission_p99_ratio": p99_ratio,
+            "ingest_lag_p95_ms": lag_p95,
+            "ingest": sink_replay,
+        }
     share = run_weighted_share_soak(
         seed=seed, base_dir=os.path.join(base_dir, "share"))
     pooled = run_slow_tenant_soak(
@@ -974,6 +1323,7 @@ def run_scale_soak(experiments: int = 520, runners: int = 8,
     pooled_p95, unpooled_p95 = _max_rtt(pooled), _max_rtt(unpooled)
     violations: List[str] = []
     violations.extend("churn: {}".format(v) for v in churn["violations"])
+    violations.extend("sink: {}".format(v) for v in sink_violations)
     violations.extend("share: {}".format(v) for v in share["violations"])
     violations.extend("slow_tenant(pool=on): {}".format(v)
                       for v in pooled["violations"])
@@ -994,6 +1344,7 @@ def run_scale_soak(experiments: int = 520, runners: int = 8,
                     pooled_p95, unpooled_p95))
     detail = {
         "churn": churn["detail"],
+        "sink": sink_detail,
         "share": share["detail"],
         "slow_tenant_ab": {
             "pooled_victim_reply_ms": pooled_p95,

@@ -333,6 +333,31 @@ def render_fleet(status: Dict[str, Any],
                     " -> {}".format(a.get("lease")) if a.get("lease")
                     else "",
                     a.get("leases"), a.get("last_beat_age_s")))
+    sink = status.get("sink") or {}
+    if sink:
+        # Per-source telemetry fan-in lag: how far behind the unified
+        # journal dir is for each tenant/agent — backlog still buffered
+        # fleet-side plus the age of the newest ingested event. A
+        # DEGRADED source's shipper lost the sink and is journaling
+        # locally (it re-ships on reconnect).
+        lines.append("journal sink: {} source(s)".format(len(sink)))
+        for src, s in sorted(sink.items()):
+            lines.append(
+                "  {}: backlog {}, last event {}s ago, "
+                "{} event(s) in {} batch(es){}".format(
+                    src, s.get("backlog", 0),
+                    s.get("last_event_age_s"),
+                    s.get("ingested"), s.get("batches"),
+                    " DEGRADED" if s.get("degraded") else ""))
+    sreplay = replay.get("sink") or {}
+    if sreplay.get("batches"):
+        lag = sreplay.get("lag_ms") or {}
+        lines.append(
+            "sink ingest: {} event(s) / {} batch(es) from {} source(s), "
+            "lag p50 {} ms / p95 {} ms, {} dup dropped".format(
+                sreplay.get("events"), sreplay.get("batches"),
+                sreplay.get("sources"), lag.get("median_ms"),
+                lag.get("p95_ms"), sreplay.get("dup", 0)))
     areplay = replay.get("agents") or {}
     if areplay.get("joins"):
         abind = areplay.get("abind_ms") or {}

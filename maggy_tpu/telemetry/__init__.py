@@ -45,14 +45,27 @@ class Telemetry:
     """
 
     def __init__(self, env=None, journal_path: Optional[str] = None,
-                 enabled: bool = True, flush_interval_s: float = 1.0):
+                 enabled: bool = True, flush_interval_s: float = 1.0,
+                 sink=None, sink_source: Optional[str] = None):
         self.enabled = enabled
         self.metrics = MetricsRegistry()
         self.spans = SpanTracker()
         self.journal: Optional[TelemetryJournal] = None
         if enabled and env is not None and journal_path:
-            self.journal = TelemetryJournal(
-                env, journal_path, flush_interval_s=flush_interval_s)
+            if sink is not None:
+                # Fleet journal-sink routing (telemetry/sink.py): events
+                # ship to the fleet's sink service instead of a private
+                # flusher thread; journal_path stays the LOCAL fallback
+                # file the shipper degrades to when the sink is down.
+                from maggy_tpu.telemetry.sink import SinkJournal
+
+                self.journal = SinkJournal(
+                    env, journal_path, binding=sink,
+                    source=sink_source or journal_path,
+                    metrics_fn=self.metrics.snapshot)
+            else:
+                self.journal = TelemetryJournal(
+                    env, journal_path, flush_interval_s=flush_interval_s)
         # Journal-less fallback buffer (no env/path given): spans still
         # derive for the TELEM verb, just without persistence.
         self._local_lock = threading.Lock()

@@ -41,6 +41,14 @@ def main(argv=None) -> int:
     pt.add_argument("path", help="experiment dir or telemetry.jsonl path")
     pt.add_argument("-o", "--out",
                     help="output file (default: <exp_dir>/trace.json)")
+    pt.add_argument("--unified", action="store_true",
+                    help="fleet home dirs only: merge fleet.jsonl, the "
+                         "journal sink's per-source segments "
+                         "(<home>/journal/), and surviving local "
+                         "journals into ONE trace — agent process "
+                         "groups, clock-offset-corrected cross-process "
+                         "timestamps, and ABIND->execution->FINAL flow "
+                         "arrows (docs/user.md walkthrough)")
     pr = sub.add_parser("replay", help="print journal-derived scheduling "
                                        "numbers as JSON")
     pr.add_argument("path", help="experiment dir or telemetry.jsonl path")
@@ -49,9 +57,14 @@ def main(argv=None) -> int:
     # A fleet home dir (fleet.jsonl present) renders the multiplexed
     # timeline: one track per fleet RUNNER with a lane per experiment,
     # built from the fleet journal + every leased experiment's journal.
+    # --unified additionally folds in the journal sink's per-source
+    # segments and the agents' clock-corrected journals.
     if args.command == "trace" and os.path.isdir(args.path) and \
             os.path.exists(os.path.join(args.path, "fleet.jsonl")):
         return _fleet_trace(args)
+    if args.command == "trace" and getattr(args, "unified", False):
+        raise SystemExit("--unified needs a fleet home dir (a directory "
+                         "containing fleet.jsonl)")
 
     journal = _resolve_journal(args.path)
     if args.command == "replay":
@@ -74,8 +87,14 @@ def main(argv=None) -> int:
 def _fleet_trace(args) -> int:
     """Fleet-mode trace: experiment journals are discovered from the
     fleet journal's lease events (each carries its experiment's
-    exp_dir)."""
-    from maggy_tpu.telemetry.trace import build_fleet_trace, validate_trace
+    exp_dir). With ``--unified``, each experiment's stream is the
+    exactly-once MERGE of its sink segment and any surviving local
+    journal (deduped by event id), agents' sink-shipped journals join
+    as clock-corrected process groups, and ABIND->execution->FINAL flow
+    arrows cross the process boundary."""
+    from maggy_tpu.telemetry.trace import (build_fleet_trace,
+                                           build_unified_trace,
+                                           validate_trace)
 
     fleet_journal = os.path.join(args.path, "fleet.jsonl")
     fleet_events = read_events(fleet_journal)
@@ -83,18 +102,46 @@ def _fleet_trace(args) -> int:
     for ev in fleet_events:
         if ev.get("exp") and ev.get("exp_dir"):
             exp_dirs[ev["exp"]] = ev["exp_dir"]
+    unified = getattr(args, "unified", False)
+    sink = {}
+    if unified:
+        from maggy_tpu.telemetry.sink import SINK_DIR_NAME, read_sink_dir
+
+        sink = read_sink_dir(os.path.join(args.path, SINK_DIR_NAME))
     experiments = {}
     for name, exp_dir in exp_dirs.items():
         jp = os.path.join(exp_dir, JOURNAL_NAME)
-        if os.path.exists(jp):
-            experiments[name] = read_events(jp)
-    trace = build_fleet_trace(fleet_events, experiments)
+        local = read_events(jp) if os.path.exists(jp) else None
+        if unified:
+            from maggy_tpu.telemetry.sink import (merge_source_events,
+                                                  sanitize_source)
+
+            shipped = sink.pop(sanitize_source(name), None)
+            if shipped is not None or local is not None:
+                experiments[name] = merge_source_events(shipped, local)
+        elif local is not None:
+            experiments[name] = local
+    if unified:
+        # Every remaining sink source that matches a joined agent is
+        # that agent's own journal.
+        agent_ids = {str(ev.get("agent")) for ev in fleet_events
+                     if ev.get("ev") == "agent"
+                     and ev.get("phase") == "join" and ev.get("agent")}
+        agent_journals = {src: evs for src, evs in sink.items()
+                          if src in agent_ids}
+        trace = build_unified_trace(fleet_events, experiments,
+                                    agent_journals=agent_journals)
+        default_out = "unified_trace.json"
+    else:
+        trace = build_fleet_trace(fleet_events, experiments)
+        default_out = "fleet_trace.json"
     n = validate_trace(trace)
-    out = args.out or os.path.join(args.path, "fleet_trace.json")
+    out = args.out or os.path.join(args.path, default_out)
     with open(out, "w") as f:
         json.dump(trace, f)
-    print("fleet trace: {} fleet events + {} experiment journal(s) -> {} "
-          "trace events -> {}".format(len(fleet_events), len(experiments),
+    print("{} trace: {} fleet events + {} experiment journal(s) -> {} "
+          "trace events -> {}".format("unified" if unified else "fleet",
+                                      len(fleet_events), len(experiments),
                                       n, out))
     print("open in https://ui.perfetto.dev or chrome://tracing")
     return 0

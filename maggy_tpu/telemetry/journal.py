@@ -62,7 +62,8 @@ def _resolved_max_bytes(max_mb: Optional[float]) -> Optional[int]:
 
 class TelemetryJournal:
     def __init__(self, env, path: str, flush_interval_s: float = 1.0,
-                 max_mb: Optional[float] = None):
+                 max_mb: Optional[float] = None,
+                 start_flusher: bool = True):
         self.env = env
         self.path = path
         self.flush_interval_s = flush_interval_s
@@ -101,9 +102,15 @@ class TelemetryJournal:
         #: corruption is visible instead of quietly shrinking the dataset.
         self.torn_lines = 0
         self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._flusher, daemon=True, name=FLUSHER_THREAD_NAME)
-        self._thread.start()
+        # ``start_flusher=False`` skips the per-journal flusher thread:
+        # the caller owns the flush cadence (the fleet journal sink runs
+        # ONE flusher over its per-source writers — one thread for 500
+        # sources, not 500 threads).
+        self._thread: Optional[threading.Thread] = None
+        if start_flusher:
+            self._thread = threading.Thread(
+                target=self._flusher, daemon=True, name=FLUSHER_THREAD_NAME)
+            self._thread.start()
 
     # ------------------------------------------------------------- hot path
 
@@ -257,7 +264,8 @@ class TelemetryJournal:
                 return
             self._closed = True
         self._stop.set()
-        self._thread.join(timeout=5)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
         self.flush()
 
 

@@ -71,6 +71,20 @@ EVENT_KINDS = frozenset({
     "agent",                  # remote fleet-agent lifecycle (phase:
                               #   AGENT_PHASES — fleet journal lane per
                               #   agent; maggy_tpu.fleet.agent)
+    "jsink",                  # journal-sink ingest record: one JSINK
+                              #   batch demuxed into a per-source
+                              #   segment (source, n, dup, sid, lag_ms —
+                              #   fleet journal; telemetry/sink.py)
+    "sink_degraded",          # a source's shipper lost the sink and
+                              #   fell back to its local journal
+                              #   (telemetry/sink.py SinkJournal)
+    "sink_recovered",         # the shipper reconnected; the spooled
+                              #   suffix re-ships (sid-deduped)
+    "clock_offset",           # RTT-bounded clock-offset estimate for
+                              #   one agent vs the fleet host (offset_s,
+                              #   rtt_s — Cristian's algorithm over the
+                              #   AJOIN/ALEASE exchange; journaled
+                              #   fleet-side per agent and agent-side)
 })
 
 #: ``reason=`` on a trial ``requeued`` phase: why it re-entered the
@@ -127,6 +141,12 @@ CHAOS_KINDS = frozenset({
     # exactly once). Harness-injected like slow_tenant: the chaos plan's
     # pool-level kill cannot reach an agent in another OS process.
     "kill_agent",
+    # Sink soak (fleet/soak.py run_sink_soak): the fleet's journal-sink
+    # tenant detached mid-soak — invariant 12 (shippers degrade to local
+    # journals, re-ship on reconnect, zero lost / zero duplicate events
+    # per event id, zero experiment failures). Harness-injected: the
+    # sink is fleet infrastructure, not an experiment-plan target.
+    "kill_sink",
 })
 
 #: Health-engine event fields (``ev: "health"``).

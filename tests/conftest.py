@@ -80,6 +80,13 @@ def pytest_configure(config):
         "slow-tenant isolation smoke. The fast smokes run in tier-1; "
         "the big churn soaks live in bench.py --scale. Select with "
         "-m scale.")
+    config.addinivalue_line(
+        "markers",
+        "sink: fleet-wide telemetry fan-in tests (maggy_tpu.telemetry."
+        "sink) — the JSINK journal sink service, client shipper "
+        "degrade/re-ship exactly-once seam (invariant 12), clock-offset "
+        "estimation, metrics federation, and the unified Perfetto "
+        "trace. Select with -m sink.")
 
 
 @pytest.fixture(autouse=True)

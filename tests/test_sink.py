@@ -1,0 +1,730 @@
+"""Fleet-wide telemetry fan-in (maggy_tpu.telemetry.sink): the JSINK
+journal sink service, the client shipper's degrade/re-ship exactly-once
+seam (chaos invariant 12), clock-offset estimation, per-source metrics
+federation, and the unified Perfetto trace."""
+
+import json
+import os
+import time
+
+import pytest
+
+from maggy_tpu.core.environment import EnvSing
+from maggy_tpu.core.environment.abstractenvironment import LocalEnv
+from maggy_tpu.core.rpc import SharedServer, SinkServer
+from maggy_tpu.telemetry import Telemetry, read_events, replay_journal
+from maggy_tpu.telemetry.sink import (ClockOffsetEstimator, JournalSink,
+                                      SinkBinding, check_exactly_once,
+                                      merge_source_events, read_sink_dir,
+                                      sanitize_source, sink_sources)
+
+pytestmark = pytest.mark.sink
+
+
+@pytest.fixture(autouse=True)
+def local_env(tmp_path):
+    env = LocalEnv(base_dir=str(tmp_path / "exp"))
+    EnvSing.set_instance(env)
+    yield env
+    EnvSing.reset()
+
+
+def ev(sid, t=None, kind="runner_stats", **fields):
+    return {"t": t if t is not None else 1000.0 + sid, "ev": kind,
+            "sid": sid, **fields}
+
+
+# ------------------------------------------------------- clock estimator
+
+
+class TestClockOffsetEstimator:
+    def test_recovers_injected_offset_within_rtt_bound(self):
+        # Local clock leads the server by O seconds; the server stamps
+        # its reply anywhere inside the exchange window. Cristian's
+        # bound: the estimate is within rtt/2 of the true offset.
+        true_offset = 37.5
+        for server_delay_frac in (0.0, 0.3, 0.5, 0.9):
+            est = ClockOffsetEstimator()
+            t_send, rtt = 1000.0, 0.040
+            server_t = (t_send + rtt * server_delay_frac) - true_offset
+            assert est.sample(t_send, server_t, t_send + rtt)
+            assert abs(est.offset_s - true_offset) <= rtt / 2 + 1e-9
+            assert est.bound_s == pytest.approx(rtt / 2)
+
+    def test_negative_offset_recovered(self):
+        est = ClockOffsetEstimator()
+        t_send, rtt, true_offset = 500.0, 0.010, -12.0
+        server_t = (t_send + rtt / 2) - true_offset
+        est.sample(t_send, server_t, t_send + rtt)
+        assert est.offset_s == pytest.approx(true_offset, abs=rtt / 2)
+
+    def test_reestimation_converges_monotonically(self):
+        # The min-RTT filter: the error bound never widens, whatever
+        # the RTT sequence does.
+        est = ClockOffsetEstimator()
+        t = 1000.0
+        bounds = []
+        for rtt in (0.050, 0.080, 0.020, 0.400, 0.015, 0.100, 0.010):
+            server_t = (t + rtt / 2) - 5.0
+            est.sample(t, server_t, t + rtt)
+            bounds.append(est.bound_s)
+            t += 1.0
+        assert bounds == sorted(bounds, reverse=True) or all(
+            b2 <= b1 + 1e-12 for b1, b2 in zip(bounds, bounds[1:]))
+        assert est.bound_s == pytest.approx(0.005)
+        assert est.samples == 7
+
+    def test_stale_estimate_reanchors(self):
+        est = ClockOffsetEstimator(max_age_s=10.0)
+        est.sample(1000.0, 1000.005 - 5.0, 1000.01)  # tight: bound 5ms
+        assert not est.sample(1001.0, 1001.05 - 5.0, 1001.1)  # worse rtt
+        # Past max_age the worse-RTT sample re-anchors (clock drift).
+        assert est.sample(1020.0, 1020.05 - 6.0, 1020.1)
+        assert est.offset_s == pytest.approx(6.0, abs=0.05)
+
+    def test_missing_or_garbage_exchange_ignored(self):
+        est = ClockOffsetEstimator()
+        assert not est.sample(1000.0, None, 1000.01)
+        assert not est.sample(1000.0, 995.0, 999.0)  # negative rtt
+        assert est.offset_s is None
+
+
+# ------------------------------------------------------------- the sink
+
+
+class TestJournalSink:
+    def _sink(self, local_env, tmp_path, telemetry=None, **kw):
+        return JournalSink(local_env, str(tmp_path / "journal"),
+                           telemetry=telemetry, **kw)
+
+    def test_ingest_writes_per_source_and_acks(self, local_env, tmp_path):
+        sink = self._sink(local_env, tmp_path)
+        resp = sink.ingest("exp-a", [ev(1), ev(2), ev(3)])
+        assert resp == {"type": "OK", "acked": 3}
+        sink.stop()
+        events = read_events(sink.source_path("exp-a"))
+        assert [e["sid"] for e in events] == [1, 2, 3]
+
+    def test_reshipped_batch_dedupes_by_sid(self, local_env, tmp_path):
+        sink = self._sink(local_env, tmp_path)
+        sink.ingest("exp-a", [ev(1), ev(2)])
+        # Lost-ack re-ship: overlap absorbed, tail appended, ack = top.
+        resp = sink.ingest("exp-a", [ev(1), ev(2), ev(3)])
+        assert resp["acked"] == 3
+        sink.stop()
+        events = read_events(sink.source_path("exp-a"))
+        assert [e["sid"] for e in events] == [1, 2, 3]
+        assert sink.snapshot()["exp-a"]["dup"] == 2
+
+    def test_ingest_journals_jsink_record_and_metrics(self, local_env,
+                                                     tmp_path):
+        fleet_telem = Telemetry(enabled=True)  # journal-less buffer
+        sink = self._sink(local_env, tmp_path, telemetry=fleet_telem)
+        sink.ingest("exp-a", [ev(1, t=time.time() - 0.5)])
+        jsinks = [e for e in fleet_telem.events() if e["ev"] == "jsink"]
+        assert len(jsinks) == 1
+        assert jsinks[0]["source"] == "exp-a"
+        assert jsinks[0]["n"] == 1
+        assert jsinks[0]["lag_ms"] >= 400
+        snap = fleet_telem.metrics.snapshot()
+        assert snap["counters"]["sink.batches"] == 1
+        assert snap["counters"]["sink.events"] == 1
+        assert "sink.ingest_lag_ms" in snap["histograms"]
+        sink.stop()
+
+    def test_all_dup_reship_batch_still_journals_jsink(self, local_env,
+                                                       tmp_path):
+        # A re-ship fully absorbed by sid dedup must still leave a
+        # replayable jsink record (n=0, dup>0) — offline dup counts
+        # would otherwise be blind to the seam's dedup activity.
+        fleet_telem = Telemetry(enabled=True)
+        sink = self._sink(local_env, tmp_path, telemetry=fleet_telem)
+        sink.ingest("a", [ev(1), ev(2)])
+        sink.ingest("a", [ev(1), ev(2)])  # lost-ack re-ship, all dup
+        jsinks = [e for e in fleet_telem.events() if e["ev"] == "jsink"]
+        assert len(jsinks) == 2
+        assert jsinks[1]["n"] == 0 and jsinks[1]["dup"] == 2
+        # Empty keepalive probes still skip.
+        sink.ingest("a", [])
+        assert len([e for e in fleet_telem.events()
+                    if e["ev"] == "jsink"]) == 2
+        sink.stop()
+
+    def test_ingest_lag_is_skew_free_with_client_stamp(self, local_env,
+                                                       tmp_path):
+        # A remote agent's clock leads the fleet host by an hour; the
+        # client_t ship stamp keeps both ends of the lag measurement on
+        # the SOURCE clock, so the lag is the true ~200 ms event age —
+        # neither clamped to 0 nor inflated to the skew.
+        fleet_telem = Telemetry(enabled=True)
+        sink = self._sink(local_env, tmp_path, telemetry=fleet_telem)
+        skewed_now = time.time() + 3600.0
+        sink.ingest("agent-1", [ev(1, t=skewed_now - 0.2)],
+                    client_t=skewed_now)
+        jsink = [e for e in fleet_telem.events()
+                 if e["ev"] == "jsink"][0]
+        assert 150 <= jsink["lag_ms"] <= 1000
+        snap = sink.snapshot()["agent-1"]
+        assert snap["last_event_age_s"] < 5.0  # not 3600
+        sink.stop()
+
+    def test_degraded_flag_follows_source_reports(self, local_env,
+                                                  tmp_path):
+        sink = self._sink(local_env, tmp_path)
+        sink.ingest("a", [ev(1), ev(2, kind="sink_degraded")])
+        assert sink.snapshot()["a"]["degraded"] is True
+        sink.ingest("a", [ev(3, kind="sink_recovered")])
+        assert sink.snapshot()["a"]["degraded"] is False
+        sink.stop()
+
+    def test_federated_snapshots_render_per_source_labels(self, local_env,
+                                                          tmp_path):
+        from maggy_tpu.telemetry.obs import render_prometheus
+
+        sink = self._sink(local_env, tmp_path)
+        sink.ingest("agent-1", [ev(1)],
+                    counters={"counters": {"agent.leases": 4},
+                              "gauges": {"agent.rss_mb": 12.5}})
+        snaps = sink.federated_snapshots()
+        assert snaps[0][0]["experiment"] == "agent-1"
+        text = render_prometheus(snaps)
+        assert 'maggy_tpu_agent_leases_total{experiment="agent-1"' in text
+        assert "12.5" in text
+        sink.stop()
+
+    def test_rotation_seals_per_source_segments(self, local_env,
+                                                tmp_path):
+        sink = self._sink(local_env, tmp_path, max_mb=0.0005)  # ~500 B
+        big = "x" * 120
+        for i in range(1, 21):
+            sink.ingest("a", [ev(i, pad=big)])
+            sink._writers["a"].flush()
+        sink.stop()
+        seg1 = sink.source_path("a") + ".000001"
+        assert os.path.exists(seg1)
+        events = read_events(sink.source_path("a"))
+        assert [e["sid"] for e in events] == list(range(1, 21))
+
+    def test_bad_batches_rejected(self, local_env, tmp_path):
+        sink = self._sink(local_env, tmp_path)
+        assert sink.ingest(None, [ev(1)])["type"] == "ERR"
+        assert sink.ingest("", [ev(1)])["type"] == "ERR"
+        sink.stop()
+        assert sink.ingest("a", [ev(1)])["type"] == "ERR"
+
+
+class TestTornSegments:
+    """Satellite regression: readers sum torn_lines across sink-written
+    per-source segments and tolerate a torn tail in a segment that is
+    still being appended — not just in the active file."""
+
+    def _write(self, path, events, truncate_last=False):
+        payload = "".join(json.dumps(e) + "\n" for e in events)
+        if truncate_last:
+            payload = payload[:-len(payload.splitlines()[-1]) // 2 - 1]
+        with open(path, "w") as f:
+            f.write(payload)
+
+    def test_mid_line_truncated_segment_counts_torn(self, tmp_path):
+        base = str(tmp_path / "src.jsonl")
+        # Sealed segment whose tail was torn mid-line (hard kill during
+        # the sink's copy-then-truncate window).
+        self._write(base + ".000001", [ev(1), ev(2), ev(3)],
+                    truncate_last=True)
+        self._write(base + ".000002", [ev(4), ev(5)])
+        self._write(base, [ev(6), ev(7)], truncate_last=True)
+        events = read_events(base)
+        assert [e["sid"] for e in events] == [1, 2, 4, 5, 6]
+        assert events.torn_lines == 2  # one per torn file, summed
+        replay = replay_journal(base)
+        assert replay["torn_lines"] == 2
+
+    def test_read_sink_dir_tolerates_torn_tails(self, tmp_path):
+        d = tmp_path / "journal"
+        d.mkdir()
+        self._write(str(d / "a.jsonl"), [ev(1), ev(2)],
+                    truncate_last=True)
+        self._write(str(d / "b.jsonl"), [ev(1)])
+        out = read_sink_dir(str(d))
+        assert set(out) == {"a", "b"}
+        assert out["a"].torn_lines == 1
+        assert [e["sid"] for e in out["a"]] == [1]
+
+    def test_sink_sources_ignores_segments(self, tmp_path):
+        d = tmp_path / "journal"
+        d.mkdir()
+        self._write(str(d / "a.jsonl"), [ev(1)])
+        self._write(str(d / "a.jsonl.000001"), [ev(1)])
+        assert list(sink_sources(str(d))) == ["a"]
+
+
+class TestMergeExactlyOnce:
+    def test_merge_dedupes_by_sid_and_sorts(self):
+        shipped = [ev(1), ev(2), ev(3)]
+        local = [ev(2), ev(3), ev(4)]
+        merged = merge_source_events(shipped, local)
+        assert [e["sid"] for e in merged] == [1, 2, 3, 4]
+        assert check_exactly_once(merged, expected_max_sid=4) == []
+
+    def test_lost_event_detected(self):
+        merged = merge_source_events([ev(1), ev(3)])
+        out = check_exactly_once(merged, expected_max_sid=3)
+        assert len(out) == 1 and "lost" in out[0]
+
+    def test_expected_tail_detected(self):
+        merged = merge_source_events([ev(1), ev(2)])
+        out = check_exactly_once(merged, expected_max_sid=4)
+        assert len(out) == 1 and "lost" in out[0]
+
+    def test_duplicate_detected_without_sid_dedup(self):
+        # A raw (unmerged) stream that really carries a sid twice.
+        out = check_exactly_once([ev(1), ev(1), ev(2)],
+                                 expected_max_sid=2)
+        assert len(out) == 1 and "duplicate" in out[0]
+
+    def test_sidless_events_pass_through(self):
+        merged = merge_source_events([{"t": 1.0, "ev": "fleet"}],
+                                     [{"t": 2.0, "ev": "fleet"}])
+        assert len(merged) == 2
+        assert check_exactly_once(merged) == []
+
+    def test_sanitize_source(self):
+        assert sanitize_source("exp a/b") == "exp_a_b"
+        assert sanitize_source("a1-x.y_z") == "a1-x.y_z"
+
+
+# --------------------------------------------- shipper end-to-end seam
+
+
+class TestShipperSeam:
+    """The full client seam over a real shared socket: ship, sink death
+    (degrade to the local journal), restart (recover + re-ship), and the
+    exactly-once merge across the seam — invariant 12's unit half."""
+
+    @pytest.mark.timeout(60)
+    def test_degrade_reship_exactly_once(self, local_env, tmp_path):
+        shared = SharedServer()
+        sink = JournalSink(local_env, str(tmp_path / "journal"))
+        srv = SinkServer()
+        srv.attach_sink(sink)
+        addr = shared.attach(srv)
+        binding = SinkBinding(addr, srv.secret_hex)
+        local_path = str(tmp_path / "local.jsonl")
+        telem = Telemetry(env=local_env, journal_path=local_path,
+                          enabled=True, sink=binding, sink_source="exp-a")
+        try:
+            for i in range(10):
+                telem.event("runner_stats", partition=0, i=i)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and \
+                    sink.snapshot().get("exp-a", {}).get("ingested",
+                                                         0) < 10:
+                time.sleep(0.05)
+            assert sink.snapshot()["exp-a"]["ingested"] >= 10
+            # Healthy path: nothing written locally.
+            assert not os.path.exists(local_path)
+
+            shared.detach(srv)  # kill the sink tenant
+            for i in range(10, 20):
+                telem.event("runner_stats", partition=0, i=i)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline \
+                    and not telem.journal.degraded:
+                time.sleep(0.05)
+            assert telem.journal.degraded
+            assert os.path.exists(local_path)  # local fallback is real
+
+            shared.attach(srv)  # restart under the same secret
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and telem.journal.degraded:
+                time.sleep(0.05)
+            assert not telem.journal.degraded
+            for i in range(20, 25):
+                telem.event("runner_stats", partition=0, i=i)
+        finally:
+            telem.close()
+            shared.stop()
+            sink.stop()
+        expected = telem.journal.max_sid()
+        shipped = read_events(str(tmp_path / "journal" / "exp-a.jsonl"))
+        local = read_events(local_path)
+        merged = merge_source_events(shipped, local)
+        assert check_exactly_once(merged,
+                                  expected_max_sid=expected) == []
+        kinds = [e.get("ev") for e in merged]
+        assert kinds.count("sink_degraded") == 1
+        assert kinds.count("sink_recovered") == 1
+
+    @pytest.mark.timeout(30)
+    def test_shipper_registry_refcounts_per_binding(self, local_env,
+                                                    tmp_path):
+        from maggy_tpu.telemetry import sink as sink_mod
+
+        shared = SharedServer()
+        service = JournalSink(local_env, str(tmp_path / "journal"))
+        srv = SinkServer()
+        srv.attach_sink(service)
+        addr = shared.attach(srv)
+        binding = SinkBinding(addr, srv.secret_hex)
+        t1 = Telemetry(env=local_env,
+                       journal_path=str(tmp_path / "l1.jsonl"),
+                       enabled=True, sink=binding, sink_source="one")
+        t2 = Telemetry(env=local_env,
+                       journal_path=str(tmp_path / "l2.jsonl"),
+                       enabled=True, sink=binding, sink_source="two")
+        try:
+            assert t1.journal.shipper is t2.journal.shipper
+            assert binding.key() in sink_mod._SHIPPERS
+        finally:
+            t1.close()
+            assert binding.key() in sink_mod._SHIPPERS  # t2 still open
+            t2.close()
+            shared.stop()
+            service.stop()
+        assert binding.key() not in sink_mod._SHIPPERS
+
+
+# ------------------------------------------------------- unified trace
+
+
+def _skewed_fixture(skew=120.0):
+    T = 1000000.0
+    fleet = [
+        {"t": T, "ev": "fleet", "phase": "start", "name": "f"},
+        {"t": T + 0.5, "ev": "agent", "phase": "join", "agent": "a1-x",
+         "runner": 2, "host": "hostA"},
+        {"t": T + 0.6, "ev": "agent", "phase": "join", "agent": "a2-y",
+         "runner": 3, "host": "hostB"},
+        {"t": T + 0.7, "ev": "clock_offset", "agent": "a1-x",
+         "offset_s": skew, "rtt_s": 0.002},
+        {"t": T + 0.7, "ev": "clock_offset", "agent": "a2-y",
+         "offset_s": -skew, "rtt_s": 0.002},
+        {"t": T + 1.0, "ev": "lease", "phase": "start", "exp": "e1",
+         "pid": 0, "runner": 2},
+        {"t": T + 1.0, "ev": "agent", "phase": "lease", "agent": "a1-x",
+         "exp": "e1", "pid": 0, "abind_ms": 5},
+        {"t": T + 1.1, "ev": "lease", "phase": "start", "exp": "e1",
+         "pid": 1, "runner": 3},
+        {"t": T + 1.1, "ev": "agent", "phase": "lease", "agent": "a2-y",
+         "exp": "e1", "pid": 1, "abind_ms": 4},
+        {"t": T + 5.0, "ev": "lease", "phase": "end", "exp": "e1",
+         "pid": 0, "runner": 2},
+        {"t": T + 5.1, "ev": "lease", "phase": "end", "exp": "e1",
+         "pid": 1, "runner": 3},
+    ]
+    exps = {"e1": [
+        {"t": T + 1.2, "ev": "trial", "trial": "t1", "span": "s1",
+         "phase": "assigned", "partition": 0},
+        {"t": T + 4.0, "ev": "trial", "trial": "t1", "span": "s1",
+         "phase": "finalized", "partition": 0},
+        {"t": T + 1.4, "ev": "trial", "trial": "t2", "span": "s2",
+         "phase": "assigned", "partition": 1},
+        {"t": T + 4.1, "ev": "trial", "trial": "t2", "span": "s2",
+         "phase": "finalized", "partition": 1},
+    ]}
+    # Each agent journals on its OWN skewed clock (a1 ahead, a2 behind).
+    agents = {
+        "a1-x": [
+            {"t": T + skew + 1.05, "ev": "agent", "phase": "lease",
+             "agent": "a1-x", "exp": "e1", "pid": 0, "sid": 1},
+            {"t": T + skew + 4.5, "ev": "agent", "phase": "done",
+             "agent": "a1-x", "exp": "e1", "pid": 0, "sid": 2},
+        ],
+        "a2-y": [
+            {"t": T - skew + 1.15, "ev": "agent", "phase": "lease",
+             "agent": "a2-y", "exp": "e1", "pid": 1, "sid": 1},
+            {"t": T - skew + 4.6, "ev": "agent", "phase": "done",
+             "agent": "a2-y", "exp": "e1", "pid": 1, "sid": 2},
+        ],
+    }
+    return fleet, exps, agents
+
+
+class TestUnifiedTrace:
+    def test_agent_process_groups_and_flow_arrows(self):
+        from maggy_tpu.telemetry.trace import (build_unified_trace,
+                                               validate_trace)
+
+        fleet, exps, agents = _skewed_fixture()
+        trace = build_unified_trace(fleet, exps, agent_journals=agents)
+        validate_trace(trace)
+        other = trace["otherData"]
+        assert other["agents"] == ["a1-x", "a2-y"]
+        assert other["flows"] == 2
+        names = [e["args"]["name"] for e in trace["traceEvents"]
+                 if e.get("ph") == "M" and e.get("name") == "process_name"]
+        assert "agent a1-x @hostA" in names
+        assert "agent a2-y @hostB" in names
+        phases = sorted(e["ph"] for e in trace["traceEvents"]
+                        if e.get("cat") == "flow")
+        assert phases == ["f", "f", "s", "s", "t", "t"]
+
+    def test_skewed_clocks_order_correctly_across_lease_boundary(self):
+        # Satellite: two fake-skewed processes (+/-120 s); after the
+        # journaled offsets are applied, each agent's execution slice
+        # starts AFTER its ABIND dispatch and ends before/at its trial's
+        # FINAL — causally consistent cross-process ordering.
+        from maggy_tpu.telemetry.trace import build_unified_trace
+
+        fleet, exps, agents = _skewed_fixture(skew=120.0)
+        trace = build_unified_trace(fleet, exps, agent_journals=agents)
+        execs = {e["args"]["agent"]: e for e in trace["traceEvents"]
+                 if e.get("cat") == "agent" and e.get("ph") == "X"}
+        abinds = {e["args"]["agent"]: e for e in trace["traceEvents"]
+                  if str(e.get("name", "")).startswith("abind ")}
+        finals = [e for e in trace["traceEvents"]
+                  if e.get("cat") == "flow" and e["ph"] == "f"]
+        assert set(execs) == {"a1-x", "a2-y"}
+        for aid, ex in execs.items():
+            assert ex["ts"] >= abinds[aid]["ts"]
+            assert ex["ts"] - abinds[aid]["ts"] < 1_000_000  # < 1 s
+        for f in finals:
+            # FINAL lands after both exec starts — not 120 s away.
+            assert all(f["ts"] >= ex["ts"] for ex in execs.values())
+
+    def test_offsets_param_overrides_journal(self):
+        from maggy_tpu.telemetry.trace import build_unified_trace
+
+        fleet, exps, agents = _skewed_fixture(skew=120.0)
+        fleet = [e for e in fleet if e.get("ev") != "clock_offset"]
+        trace = build_unified_trace(
+            fleet, exps, agent_journals=agents,
+            offsets={"a1-x": 120.0, "a2-y": -120.0})
+        execs = [e for e in trace["traceEvents"]
+                 if e.get("cat") == "agent" and e.get("ph") == "X"]
+        for ex in execs:
+            assert ex["ts"] < 10_000_000  # corrected, not 120 s off
+
+    def test_unified_cli_on_fleet_home(self, tmp_path):
+        from maggy_tpu.telemetry.__main__ import main as telem_main
+
+        fleet, exps, agents = _skewed_fixture()
+        home = tmp_path / "fleethome"
+        (home / "journal").mkdir(parents=True)
+        with open(home / "fleet.jsonl", "w") as f:
+            for e in fleet:
+                f.write(json.dumps(e) + "\n")
+        with open(home / "journal" / "e1.jsonl", "w") as f:
+            for i, e in enumerate(exps["e1"], start=1):
+                f.write(json.dumps({**e, "sid": i}) + "\n")
+        for aid, evs in agents.items():
+            with open(home / "journal" / (aid + ".jsonl"), "w") as f:
+                for e in evs:
+                    f.write(json.dumps(e) + "\n")
+        rc = telem_main(["trace", str(home), "--unified"])
+        assert rc == 0
+        out = home / "unified_trace.json"
+        assert out.exists()
+        trace = json.loads(out.read_text())
+        assert trace["otherData"]["flows"] == 2
+        assert trace["otherData"]["agents"] == ["a1-x", "a2-y"]
+
+    def test_unified_needs_fleet_home(self, tmp_path):
+        from maggy_tpu.telemetry.__main__ import main as telem_main
+
+        with pytest.raises(SystemExit):
+            telem_main(["trace", str(tmp_path / "nope"), "--unified"])
+
+
+# --------------------------------------------- monitor + fleet replay
+
+
+class TestMonitorSinkView:
+    def test_zero_lag_rendering(self):
+        from maggy_tpu.monitor import render_fleet
+
+        status = {"name": "f", "runners": 2, "active": 1,
+                  "queue_depth": 0, "experiments": [],
+                  "sink": {"exp-a": {"backlog": 0, "ingested": 42,
+                                     "batches": 7, "degraded": False,
+                                     "last_event_age_s": 0.1,
+                                     "last_ingest_age_s": 0.1}}}
+        text = render_fleet(status, {})
+        assert "journal sink: 1 source(s)" in text
+        assert "exp-a: backlog 0, last event 0.1s ago" in text
+        assert "DEGRADED" not in text
+
+    def test_degraded_source_flagged(self):
+        from maggy_tpu.monitor import render_fleet
+
+        status = {"name": "f", "runners": 2, "experiments": [],
+                  "sink": {"agent-1": {"backlog": 5, "ingested": 10,
+                                       "batches": 2, "degraded": True,
+                                       "last_event_age_s": 12.7,
+                                       "last_ingest_age_s": 12.7}}}
+        text = render_fleet(status, {})
+        assert "agent-1: backlog 5" in text
+        assert "DEGRADED" in text
+
+    def test_replay_sink_ingest_line(self):
+        from maggy_tpu.monitor import render_fleet
+
+        replay = {"sink": {"batches": 3, "events": 30, "dup": 2,
+                           "sources": 2,
+                           "lag_ms": {"median_ms": 120.0,
+                                      "p95_ms": 400.0, "n": 3}}}
+        text = render_fleet({"name": "f", "experiments": []}, replay)
+        assert "sink ingest: 30 event(s) / 3 batch(es)" in text
+        assert "lag p50 120.0 ms / p95 400.0 ms" in text
+        assert "2 dup dropped" in text
+
+    def test_no_sink_block_renders_nothing(self):
+        from maggy_tpu.monitor import render_fleet
+
+        text = render_fleet({"name": "f", "experiments": []}, {})
+        assert "journal sink" not in text
+        assert "sink ingest" not in text
+
+
+class TestReplayFleetJournalSinkBlocks:
+    def test_jsink_and_clock_offset_replayed(self, tmp_path):
+        from maggy_tpu.fleet import replay_fleet_journal
+
+        path = tmp_path / "fleet.jsonl"
+        events = [
+            {"t": 1.0, "ev": "fleet", "phase": "start", "name": "f"},
+            {"t": 2.0, "ev": "jsink", "source": "a", "n": 10, "dup": 1,
+             "sid": 10, "lag_ms": 50.0},
+            {"t": 3.0, "ev": "jsink", "source": "b", "n": 5, "dup": 0,
+             "sid": 5, "lag_ms": 150.0},
+            {"t": 4.0, "ev": "clock_offset", "agent": "a1",
+             "offset_s": 0.5, "rtt_s": 0.01},
+            {"t": 5.0, "ev": "clock_offset", "agent": "a1",
+             "offset_s": 0.4, "rtt_s": 0.005},
+        ]
+        with open(path, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        replay = replay_fleet_journal(str(path))
+        assert replay["sink"]["batches"] == 2
+        assert replay["sink"]["events"] == 15
+        assert replay["sink"]["dup"] == 1
+        assert replay["sink"]["sources"] == 2
+        assert replay["sink"]["lag_ms"]["n"] == 2
+        clock = replay["clock_offsets"]["a1"]
+        assert clock["offset_s"] == 0.4  # last report wins
+        assert clock["reports"] == 2
+
+
+# ------------------------------------------------------------- e2e
+
+
+class TestFleetSinkE2E:
+    @pytest.mark.timeout(120)
+    def test_churn_tenants_ship_through_sink(self, local_env, tmp_path):
+        from maggy_tpu import experiment
+        from maggy_tpu.fleet import Fleet
+        from maggy_tpu.fleet.soak import _scale_config, scale_train_fn
+
+        base = str(tmp_path / "runs")
+        fleet = Fleet(runners=2, home_dir=str(tmp_path / "fleet"))
+        with fleet:
+            handles = {}
+            for i in range(2):
+                name = "tenant{:02d}".format(i)
+                handles[name] = experiment.lagom_submit(
+                    scale_train_fn,
+                    _scale_config(name, 2, base, seed=7 + i,
+                                  hb_interval=0.05, sink=True),
+                    fleet=fleet, block=False, name=name)
+            for name, h in handles.items():
+                assert h.result(timeout=90)["num_trials"] == 2
+        sink_dir = os.path.join(fleet.home_dir, "journal")
+        sources = read_sink_dir(sink_dir)
+        assert set(sources) >= {"tenant00", "tenant01"}
+        for name in ("tenant00", "tenant01"):
+            events = sources[name]
+            finals = [e for e in events if e.get("ev") == "trial"
+                      and e.get("phase") == "finalized"]
+            assert len(finals) == 2
+            assert check_exactly_once(
+                merge_source_events(events)) == []
+            # The per-source sink file replays like any journal.
+            replay = replay_journal(
+                os.path.join(sink_dir, name + ".jsonl"))
+            assert replay["trials"]["finalized"] == 2
+        # Healthy sink: no local telemetry.jsonl was ever written.
+        fleet_events = read_events(
+            os.path.join(fleet.home_dir, "fleet.jsonl"))
+        assert any(e.get("ev") == "jsink" for e in fleet_events)
+
+    @pytest.mark.timeout(60)
+    def test_sink_disabled_keeps_local_journals(self, local_env,
+                                                tmp_path):
+        from maggy_tpu import experiment
+        from maggy_tpu.fleet import Fleet
+        from maggy_tpu.fleet.soak import _scale_config, scale_train_fn
+        from maggy_tpu.telemetry import JOURNAL_NAME
+
+        base = str(tmp_path / "runs")
+        fleet = Fleet(runners=2, home_dir=str(tmp_path / "fleet"),
+                      sink=False)
+        with fleet:
+            assert fleet.sink_binding() is None
+            h = experiment.lagom_submit(
+                scale_train_fn,
+                _scale_config("solo", 1, base, seed=3,
+                              hb_interval=0.05, telemetry=True),
+                fleet=fleet, block=False, name="solo")
+            assert h.result(timeout=45)["num_trials"] == 1
+            drv = h.entry.driver
+            assert os.path.exists(
+                os.path.join(drv.exp_dir, JOURNAL_NAME))
+        assert not os.path.isdir(os.path.join(fleet.home_dir, "journal"))
+
+
+class TestAgentClockE2E:
+    @pytest.mark.timeout(90)
+    @pytest.mark.agent
+    def test_agent_reports_offset_and_ticket_carries_sink(self,
+                                                          local_env,
+                                                          tmp_path):
+        from maggy_tpu.fleet import Fleet, read_fleet_ticket
+        from maggy_tpu.fleet.agent import FleetAgent
+
+        fleet = Fleet(runners=1, max_agents=1,
+                      home_dir=str(tmp_path / "fleet"),
+                      agent_liveness_s=5.0)
+        with fleet:
+            ticket_path = os.path.join(fleet.home_dir,
+                                       "agent_ticket.json")
+            ticket = read_fleet_ticket(ticket_path, wait_s=10.0)
+            assert ticket["sink"] == fleet.sink_server.secret_hex
+            agent = FleetAgent(ticket, home=str(tmp_path / "agent"))
+            agent.join()
+            assert agent.clock.offset_s is not None
+            # Same host, same clock: the estimate must be ~zero within
+            # its own RTT/2 bound.
+            assert abs(agent.clock.offset_s) <= max(
+                agent.clock.bound_s, 0.25)
+            agent.run(idle_exit_s=1.2)
+        from maggy_tpu.fleet import (FLEET_JOURNAL_NAME,
+                                     replay_fleet_journal)
+
+        replay = replay_fleet_journal(
+            os.path.join(fleet.home_dir, FLEET_JOURNAL_NAME))
+        clocks = replay["clock_offsets"]
+        assert agent.agent_id in clocks
+        assert clocks[agent.agent_id]["reports"] >= 1
+        assert abs(clocks[agent.agent_id]["offset_s"]) < 1.0
+
+
+class TestSinkSoakInvariant12:
+    @pytest.mark.chaos
+    @pytest.mark.timeout(180)
+    def test_kill_sink_soak_holds_invariant_12(self, local_env,
+                                               tmp_path):
+        from maggy_tpu.fleet.soak import run_sink_soak
+
+        report = run_sink_soak(tenants=2, trials=4,
+                               base_dir=str(tmp_path / "soak"),
+                               lock_witness=True)
+        assert report["ok"], report["violations"]
+        detail = report["detail"]
+        assert detail["degraded_events"] >= 1
+        assert detail["recovered_events"] >= 1
+        assert detail["witness"]["violations"] == 0
+        assert detail["witness"]["edges"] > 0
+        probe = detail["per_source"]["probe"]
+        assert probe["local_events"] > 0  # the seam was real
+        assert probe["merged"] == probe["expected"]
