@@ -774,6 +774,106 @@ def chaos_main():
     return 0 if report["ok"] else 1
 
 
+def failover_main():
+    """``bench.py --failover``: crash-only driver failover gate (see
+    maggy_tpu/chaos/driver_soak.py). Runs the kill_driver soak — a real
+    driver process SIGKILLed mid-sweep (twice by default) over surviving
+    runner-agent processes, restarted with resume=True each time — and
+    gates (a) invariant 13 over the multi-incarnation journal, (b)
+    journal-replay recovery MTTR (kill -> ``recovered`` marker) p50
+    under the bound, and (c) replayed-vs-live parity: the recovered
+    sweep's final trial-id set must be IDENTICAL to an uninterrupted run
+    of the same seeded schedule. Exit 1 on any violation."""
+    if "MAGGY_TPU_BASE_DIR" not in os.environ:
+        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
+    _force_cpu_if_requested()
+    from maggy_tpu.chaos.driver_soak import run_driver_soak
+
+    seed = int(os.environ.get("BENCH_FAILOVER_SEED", "7"))
+    kills = int(os.environ.get("BENCH_FAILOVER_KILLS", "2"))
+    trials = int(os.environ.get("BENCH_FAILOVER_TRIALS", "8"))
+    mttr_bound_s = float(os.environ.get("BENCH_FAILOVER_MTTR_S", "60"))
+    t0 = time.time()
+    report = run_driver_soak(trials=trials, workers=3, seed=seed,
+                             kills=kills, lock_witness=True)
+    mttr_s = sorted(r["mttr_s"] for r in report["failover"]["recoveries"]
+                    if r.get("mttr_s") is not None)
+    mttr_p50 = mttr_s[len(mttr_s) // 2] if mttr_s else None
+    mttr_p95 = mttr_s[int(len(mttr_s) * 0.95)] if mttr_s else None
+    violations = list(report["violations"])
+    if mttr_p50 is None:
+        violations.append("no recovery MTTR measured: no kill produced a "
+                          "recovered marker")
+    elif mttr_p50 > mttr_bound_s:
+        violations.append(
+            "recovery too slow: journal-replay MTTR p50 {:.1f}s exceeds "
+            "the {:.0f}s bound".format(mttr_p50, mttr_bound_s))
+
+    # Parity: an UNINTERRUPTED run of the same seeded schedule must
+    # produce the identical final trial-id set (trial ids are
+    # content-addressed over the params, so this compares the executed
+    # schedules exactly; the quick closed-form trial body is fine — ids
+    # do not depend on trial duration).
+    from maggy_tpu import OptimizationConfig, Searchspace, experiment
+    from maggy_tpu.chaos.harness import _soak_train_fn
+    from maggy_tpu.telemetry import JOURNAL_NAME, read_events
+
+    ref_base = tempfile.mkdtemp(prefix="maggy_failover_ref_")
+    ref_cfg = OptimizationConfig(
+        name="failover_ref", num_trials=trials, optimizer="randomsearch",
+        searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2]),
+                                units=("INTEGER", [8, 64])),
+        direction="max", num_workers=3, seed=seed, es_policy="none",
+        hb_interval=0.05, experiment_dir=ref_base)
+    experiment.lagom(_soak_train_fn, ref_cfg)
+    ref_dirs = sorted(d for d in os.listdir(ref_base)
+                      if os.path.isdir(os.path.join(ref_base, d)))
+    ref_events = read_events(os.path.join(ref_base, ref_dirs[-1],
+                                          JOURNAL_NAME))
+    soak_events = read_events(report["journal"])
+
+    def _finalized_ids(events):
+        return sorted({ev["trial"] for ev in events
+                       if ev.get("ev") == "trial"
+                       and ev.get("phase") == "finalized"})
+
+    soak_ids, ref_ids = _finalized_ids(soak_events), _finalized_ids(
+        ref_events)
+    parity = soak_ids == ref_ids
+    if not parity:
+        violations.append(
+            "replayed-vs-live parity broken: recovered sweep finalized {} "
+            "trial(s), uninterrupted run {} — symmetric difference "
+            "{}".format(len(soak_ids), len(ref_ids),
+                        sorted(set(soak_ids) ^ set(ref_ids))))
+    ok = not violations
+    print(json.dumps({
+        "metric": "driver failover (SIGKILL x{} + journal-replay "
+                  "recovery)".format(kills),
+        "value": 1.0 if ok else 0.0,
+        "unit": "invariants_ok",
+        "detail": {"failover": {
+            "seed": seed, "kills": kills, "trials": trials,
+            "wall_s": round(time.time() - t0, 1),
+            "violations": violations,
+            "mttr_p50_ms": round(mttr_p50 * 1e3, 1)
+            if mttr_p50 is not None else None,
+            "mttr_p95_ms": round(mttr_p95 * 1e3, 1)
+            if mttr_p95 is not None else None,
+            "mttr_bound_s": mttr_bound_s,
+            "driver_epochs": report["failover"]["driver_epochs"],
+            "adopted": report["failover"]["adopted"],
+            "requeued": report["trials"]["requeued"],
+            "recoveries": report["failover"]["recoveries"],
+            "parity": {"match": parity, "soak_trials": len(soak_ids),
+                       "reference_trials": len(ref_ids)},
+            "witness": report.get("witness"),
+            "journal": report["journal"],
+        }},
+    }), flush=True)
+    return 0 if ok else 1
+
+
 def fleet_main():
     """``bench.py --fleet``: shared-fleet scheduling soak (see
     maggy_tpu/fleet/). Runs two concurrent experiments over one 2-runner
@@ -1547,6 +1647,8 @@ if __name__ == "__main__":
         sys.exit(extra_main(sys.argv[sys.argv.index("--extra") + 1]))
     if "--chaos" in sys.argv:
         sys.exit(chaos_main())
+    if "--failover" in sys.argv:
+        sys.exit(failover_main())
     if "--fleet" in sys.argv:
         sys.exit(fleet_main())
     if "--pack" in sys.argv:

@@ -13,6 +13,7 @@ the standard soak runs: a runner killed mid-trial, a false preemption,
     python -m maggy_tpu.chaos --preempt                  # preemption soak
     python -m maggy_tpu.chaos --agent                    # agent-kill soak
     python -m maggy_tpu.chaos --sink                     # sink-kill soak
+    python -m maggy_tpu.chaos --driver                   # driver-kill soak
     python -m maggy_tpu.chaos --show-schedule --seed 7   # no experiment
 
 ``--preempt`` runs the graceful-preemption soak: a mid-trial trial is
@@ -101,6 +102,14 @@ def main(argv=None) -> int:
                          "mid-lease — the lease must be revoked "
                          "(reason=agent_lost) and the trial requeued "
                          "exactly once (invariant 11)")
+    ap.add_argument("--driver", action="store_true",
+                    help="run the driver-failover soak: a real driver "
+                         "process SIGKILLed mid-sweep over surviving "
+                         "runner-agent processes, restarted with "
+                         "resume=True — journal replay must rebuild the "
+                         "control plane and the sweep must complete with "
+                         "no trial lost, no duplicate FINAL, and no "
+                         "completed trial re-run (invariant 13)")
     ap.add_argument("--sink", action="store_true",
                     help="run the journal-sink soak: tenants ship their "
                          "telemetry through the fleet's journal sink, "
@@ -131,13 +140,24 @@ def main(argv=None) -> int:
     from maggy_tpu.chaos.plan import FaultPlan
 
     modes = [m for m in ("stall", "piggyback", "preempt", "gang", "agent",
-                         "sink")
+                         "sink", "driver")
              if getattr(args, m)]
     if args.plan and modes:
         ap.error("--{} uses a built-in plan; drop --plan".format(modes[0]))
     if len(modes) > 1:
         ap.error("pick one of --stall / --piggyback / --preempt / --gang "
-                 "/ --agent / --sink")
+                 "/ --agent / --sink / --driver")
+    if args.driver:
+        # The driver soak owns its whole topology (driver + runner-agent
+        # SUBPROCESSES; the kill is harness-injected — SIGKILL takes the
+        # chaos engine down with the process it targets, so no in-process
+        # plan can record it) — delegate wholesale.
+        from maggy_tpu.chaos.driver_soak import run_driver_soak
+
+        report = run_driver_soak(seed=7 if args.seed is None else args.seed,
+                                 lock_witness=not args.no_witness)
+        print(json.dumps(report, indent=2, default=str))
+        return 0 if report["ok"] else 1
     if args.sink:
         # The sink soak owns its whole topology (a fleet whose sink
         # tenant is detached/re-attached mid-run; the kill is

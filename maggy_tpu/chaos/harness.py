@@ -59,6 +59,19 @@ checkable against any soak artifact after the fact):
     double-finalized. A trial that outran detection is the benign
     completed_before_detection outcome. ``gang_plan``, ``python -m
     maggy_tpu.chaos --gang``.
+13. **Driver failover is lossless** — over a MULTI-INCARNATION journal
+    (``driver_epoch`` events mark each (re)started driver), every
+    ``kill_driver`` fault must be followed by a later incarnation
+    (``driver_epoch``) AND a journal-replay reconstruction marker
+    (experiment phase ``recovered``); across the whole journal no trial
+    is lost, none double-finalizes, and a COMPLETED trial (successful
+    ``finalized``) never re-runs (no later ``running`` edge) — an
+    acknowledged FINAL is durable past the crash (the FINAL-path
+    barrier), so recovery re-runs only genuinely unfinished work. The
+    soak lives in chaos/driver_soak.py (``python -m maggy_tpu.chaos
+    --driver``): a real driver process SIGKILLed mid-sweep over
+    surviving runner-agent processes, restarted with ``resume=True``.
+
 9.  **The observability plane survives the faults** — with
     ``run_soak(obs=True)`` the experiment runs with the obs HTTP server
     on (config.obs_port=0) while a scraper polls /metrics, /status and
@@ -545,6 +558,8 @@ def check_invariants(events: List[Dict[str, Any]],
     check, not a violation. Passing None also skips it."""
     queued: Dict[str, float] = {}
     finalized: Dict[str, List[float]] = {}
+    finalized_ok: Dict[str, List[float]] = {}
+    running_at: Dict[str, List[float]] = {}
     requeued: Dict[str, List[float]] = {}
     requeued_evs: Dict[str, List[Dict[str, Any]]] = {}
     preempted_evs: Dict[str, List[Dict[str, Any]]] = {}
@@ -558,11 +573,21 @@ def check_invariants(events: List[Dict[str, Any]],
     experiment_finalized = False
     obs_armed = False
     profile_captures: List[Dict[str, Any]] = []
+    driver_epochs: List[Dict[str, Any]] = []
+    recovered_markers: List[Dict[str, Any]] = []
+    adopted = 0
     for ev in events:
         kind = ev.get("ev")
         t = ev.get("t")
         if kind == "chaos":
             chaos_events.append(dict(ev))
+            continue
+        if kind == "driver_epoch":
+            driver_epochs.append(dict(ev))
+            continue
+        if kind == "runner":
+            if ev.get("phase") == "adopted":
+                adopted += 1
             continue
         if kind == "obs_started":
             obs_armed = True
@@ -581,6 +606,8 @@ def check_invariants(events: List[Dict[str, Any]],
         if kind == "experiment":
             if ev.get("phase") in ("finalized", "end"):
                 experiment_finalized = True
+            elif ev.get("phase") == "recovered":
+                recovered_markers.append(dict(ev))
             continue
         if kind != "trial" or t is None:
             continue
@@ -600,8 +627,12 @@ def check_invariants(events: List[Dict[str, Any]],
             preempted_evs.setdefault(trial, []).append(dict(ev))
         elif phase == "resumed":
             resumed_evs.setdefault(trial, []).append(dict(ev))
+        elif phase == "running":
+            running_at.setdefault(trial, []).append(t)
         elif phase == "finalized":
             finalized.setdefault(trial, []).append(t)
+            if not ev.get("error"):
+                finalized_ok.setdefault(trial, []).append(t)
 
     violations: List[str] = []
     for trial in sorted(queued):
@@ -854,6 +885,52 @@ def check_invariants(events: List[Dict[str, Any]],
                     "health-flagged but journaled no profile_captured "
                     "artifact".format(pid))
 
+    # Invariant 13: driver failover is lossless. Completed trials never
+    # re-run (a successful FINAL is durable past a crash — the FINAL-path
+    # barrier — so recovery must never re-dispatch one): a ``running``
+    # edge after the trial's LAST successful finalized is a double run.
+    # Errored trials are exempt — a controller retrying a failed unit of
+    # work (PBT segment retry) legitimately re-issues the identical id.
+    for trial, times in sorted(finalized_ok.items()):
+        t_done = max(times)
+        if any(t > t_done for t in running_at.get(trial, [])):
+            violations.append(
+                "completed trial re-ran: {} has a running edge after its "
+                "successful finalized at t={:.3f}".format(trial, t_done))
+    # Every kill_driver fault must be followed by a restarted incarnation
+    # AND a journal-replay reconstruction marker — a kill with neither
+    # means the failover never happened; a restart without ``recovered``
+    # means it came back blind (artifact-only resume, not crash-only
+    # recovery).
+    failover_recs: List[Dict[str, Any]] = []
+    for ce in chaos_events:
+        if ce.get("kind") != "kill_driver":
+            continue
+        t0 = ce.get("t")
+        if t0 is None:
+            continue
+        restarts = [d for d in driver_epochs
+                    if d.get("t") is not None and d["t"] >= t0]
+        recovers = [r for r in recovered_markers
+                    if r.get("t") is not None and r["t"] >= t0]
+        rec: Dict[str, Any] = {"t": t0}
+        if not restarts:
+            rec["outcome"] = "no_restart"
+            violations.append(
+                "driver never restarted: kill_driver at t={:.3f} has no "
+                "later driver_epoch event".format(t0))
+        elif not recovers:
+            rec["outcome"] = "no_recovery"
+            violations.append(
+                "driver restarted blind: kill_driver at t={:.3f} has a "
+                "later driver_epoch but no journal-replay 'recovered' "
+                "marker".format(t0))
+        else:
+            rec["outcome"] = "recovered"
+            rec["epoch"] = restarts[0].get("epoch")
+            rec["mttr_s"] = round(min(r["t"] for r in recovers) - t0, 3)
+        failover_recs.append(rec)
+
     by_kind: Dict[str, int] = {}
     for ce in chaos_events:
         by_kind[ce["kind"]] = by_kind.get(ce["kind"], 0) + 1
@@ -874,6 +951,17 @@ def check_invariants(events: List[Dict[str, Any]],
         "profiles": {"obs_armed": obs_armed,
                      "captured": len(profile_captures),
                      "auto": len(auto_captures)},
+        # Invariant 13 (crash-only driver failover): incarnation seams,
+        # per-kill recovery outcome + MTTR, and how many pre-crash
+        # runners re-bound to the restarted driver.
+        "failover": {
+            "driver_epochs": [d.get("epoch") for d in driver_epochs],
+            "kills": sum(1 for ce in chaos_events
+                         if ce.get("kind") == "kill_driver"),
+            "recoveries": failover_recs,
+            "recovered_markers": len(recovered_markers),
+            "adopted": adopted,
+        },
     }
 
 

@@ -6,6 +6,8 @@ types and numeric types). Extended with TPU-framework defaults.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 
@@ -39,7 +41,15 @@ REGISTRATION_TIMEOUT_S = 600.0
 # cannot see it — this is its liveness bound.
 RESIZE_RESPAWN_TIMEOUT_S = 120.0
 RENDEZVOUS_TIMEOUT_S = 60.0
-CLIENT_MAX_RETRIES = 3
+# Request retry budget. Env-overridable (MAGGY_TPU_CLIENT_MAX_RETRIES)
+# because the right value depends on how long a DEAD CONTROL PLANE may
+# stay dead: the default ~0.5 s horizon suits transient blips, while
+# crash-only driver failover (the runner must outlive the driver's
+# restart — process spawn + jax import + journal replay, seconds to tens
+# of seconds) needs runners that keep retrying across the window; the
+# driver soak raises it for its runner-agent processes.
+CLIENT_MAX_RETRIES = int(os.environ.get("MAGGY_TPU_CLIENT_MAX_RETRIES",
+                                        "3"))
 # Client retry backoff: exponential from BASE doubling to CAP, with full
 # jitter (a fixed cadence synchronizes every client's retry storm onto a
 # recovering server).

@@ -15,6 +15,7 @@ processes pinned to chip sub-slices).
 
 from __future__ import annotations
 
+import json
 import os
 import queue
 import secrets as pysecrets
@@ -26,9 +27,19 @@ from typing import Any, Callable, Dict, Optional
 
 from maggy_tpu.core.environment import EnvSing
 
+#: Per-run control-plane identity, persisted into the experiment dir at
+#: init: the shared secret and the bound (host, port). Crash-only
+#: recovery reads it so the restarted driver comes back ON THE SAME
+#: SECRET AND ADDRESS — surviving runners' reconnect/retry loops then
+#: re-bind without any new discovery step. Same trust domain as the
+#: runner ticket (which already carries the secret for remote pools).
+DRIVER_STATE_FILE = "driver_state.json"
+
 
 class Driver(ABC):
     def __init__(self, config, app_id: str, run_id: int):
+        from maggy_tpu import util
+
         self.config = config
         self.app_id = app_id
         self.run_id = run_id
@@ -36,7 +47,30 @@ class Driver(ABC):
         self.description = getattr(config, "description", "")
         self.hb_interval = getattr(config, "hb_interval", 1.0)
         self.env = EnvSing.get_instance()
-        self.secret = pysecrets.token_hex(16)
+        # Incarnation claim BEFORE anything touches the run dir's
+        # artifacts: exactly one driver may (re-)enter a run dir at a
+        # time — the loser of a two-restarting-drivers adoption race
+        # exits with RunAdoptionError here, before register_experiment
+        # could clobber the interrupted run's metadata. Fresh runs claim
+        # epoch 1 (their dir was staked exclusively by claim_run_id);
+        # resume claims the next epoch.
+        base = getattr(config, "experiment_dir", None) \
+            or self.env.experiment_base_dir()
+        run_dir = "{}/{}_{}".format(base.rstrip("/"), app_id, run_id)
+        self.driver_epoch = util.claim_driver_epoch(run_dir, env=self.env)
+        # Pre-crash control-plane identity (crash-only recovery): reuse
+        # the interrupted incarnation's secret so still-live runners'
+        # HMAC-authenticated frames keep verifying against this server.
+        self.driver_state: Optional[Dict[str, Any]] = None
+        if getattr(config, "resume", False):
+            state_path = run_dir + "/" + DRIVER_STATE_FILE
+            if self.env.exists(state_path):
+                try:
+                    self.driver_state = json.loads(self.env.load(state_path))
+                except ValueError:
+                    self.driver_state = None  # torn write: fresh identity
+        self.secret = (self.driver_state or {}).get("secret") \
+            or pysecrets.token_hex(16)
 
         self.server = self._make_server()
         self.server.attach_driver(self)
@@ -87,8 +121,15 @@ class Driver(ABC):
             restored = 0
             if self.telemetry.journal is not None:
                 restored = self.telemetry.journal.load_existing()
+            # Span state rides the journal too: restored trials keep
+            # their pre-crash span ids and first-occurrence timestamps,
+            # so post-recovery phase events continue the same spans.
+            self.telemetry.restore_spans()
             self.telemetry.event("experiment", phase="resumed",
                                  restored_events=restored)
+        # Incarnation boundary marker: the seam recovery and invariant 13
+        # split a multi-incarnation journal on.
+        self.telemetry.event("driver_epoch", epoch=self.driver_epoch)
         self.telemetry.event("experiment", phase="start", name=self.name,
                              driver=type(self).__name__, app_id=app_id,
                              run_id=run_id)
@@ -264,8 +305,50 @@ class Driver(ABC):
             # authenticates each frame (rpc.SharedServer).
             self.server_addr = binding.attach_server(self.server)
         else:
-            self.server_addr = self.env.connect_host(
-                self.server, host=getattr(self.config, "bind_host", None))
+            host = getattr(self.config, "bind_host", None)
+            prev_port = int((self.driver_state or {}).get("port") or 0)
+            try:
+                # Crash-only recovery: rebind the pre-crash port so
+                # surviving runners' reconnect loops (they hold the old
+                # (host, port)) land on the restarted server. The dead
+                # process's socket is gone, so the rebind succeeds
+                # unless another process squatted the port meanwhile.
+                self.server_addr = self.env.connect_host(
+                    self.server, host=host, port=prev_port)
+            except OSError as e:
+                if prev_port == 0:
+                    raise
+                # A still-bound pre-crash port is the strongest available
+                # evidence the PRIOR incarnation is alive (wedged, not
+                # dead): the epoch marker arbitrates RACING adopters, but
+                # it cannot see a predecessor that claimed earlier and
+                # never exited — binding fresh here would run two live
+                # control planes against one run dir. Refuse; the
+                # operator clears driver_state.json if the port is in
+                # fact squatted by an unrelated process.
+                from maggy_tpu.exceptions import RunAdoptionError
+
+                raise RunAdoptionError(
+                    "cannot adopt run {}: the pre-crash control-plane "
+                    "port {} is still bound ({}) — the prior driver "
+                    "incarnation appears to be alive. If the port is "
+                    "held by an unrelated process, delete {}/{} and "
+                    "resume on a fresh port (pre-crash runners will "
+                    "requeue via the liveness scan).".format(
+                        self.exp_dir, prev_port, e, self.exp_dir,
+                        DRIVER_STATE_FILE)) from e
+        # Persist the control-plane identity: what a future incarnation
+        # needs to come back on the same secret and address.
+        try:
+            self.env.dump(json.dumps({
+                "secret": self.secret,
+                "host": self.server_addr[0],
+                "port": int(self.server_addr[1]),
+                "driver_epoch": self.driver_epoch,
+                "os_pid": os.getpid(),
+            }), self.exp_dir + "/" + DRIVER_STATE_FILE)
+        except Exception:  # noqa: BLE001 - identity mirror must not kill a run
+            pass
         self._start_worker()
         if getattr(self.config, "verbose", False):
             self._start_progress_printer()

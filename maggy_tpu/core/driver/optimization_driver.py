@@ -384,7 +384,21 @@ class OptimizationDriver(Driver):
         # Carry the interrupted run's early-stop count so the resumed
         # result.json covers all the trials it claims to.
         self.result["early_stopped"] += sum(1 for t in restored if t.early_stop)
-        self.controller.restore(restored)
+        # Crash-only recovery (core/driver/recovery.py): rebuild the
+        # IN-FLIGHT half from the journal — committed-but-unfinalized
+        # trials re-enter the store with their pre-crash run epochs and
+        # holding partitions, the reservation table is re-seeded so
+        # still-live runners re-bind (adopted) and dead ones requeue via
+        # the ordinary slot-reclaim liveness. Runs BEFORE the controller
+        # restore so buffer-backed samplers can drop the in-flight
+        # configs too (they are already minted — re-suggesting them
+        # would collide in the store).
+        from maggy_tpu.core.driver import recovery as recovery_mod
+
+        recovered_stats = recovery_mod.recover_optimization_driver(self)
+        with self._store_lock:
+            inflight = list(self._trial_store.values())
+        self.controller.restore_from_finals(restored, inflight=inflight)
         if self.controller.pruner is not None:
             path = self.exp_dir + "/" + constants.PRUNER_STATE_FILE
             if not self.env.exists(path):
@@ -398,8 +412,17 @@ class OptimizationDriver(Driver):
                     json.loads(self.env.load(path)))
                 self.controller.pruner.restore(
                     {t.trial_id for t in restored})
-        self._log("resume: restored {} finalized trials from {}".format(
-            len(restored), self.exp_dir))
+        if recovered_stats is not None:
+            self.telemetry.event("experiment", phase="recovered",
+                                 finalized=len(restored),
+                                 **recovered_stats)
+        self._log("resume: restored {} finalized trials from {}{}".format(
+            len(restored), self.exp_dir,
+            "; recovered {} in-flight trial(s) across {} partition(s) "
+            "from the journal".format(
+                recovered_stats["inflight"],
+                recovered_stats["recovered_partitions"])
+            if recovered_stats is not None else ""))
 
     # ------------------------------------------------------------ callbacks
 
@@ -1664,8 +1687,23 @@ class OptimizationDriver(Driver):
         """Mint the trial's telemetry span when the driver commits to it
         ("queued") and plant the span id in its info_dict — the TRIAL reply
         ships info, so the span travels to the runner for free and comes
-        back on its METRIC/FINAL messages."""
-        span = self.telemetry.trial_event(trial.trial_id, "queued")
+        back on its METRIC/FINAL messages. The queued edge carries the
+        trial's PARAMS: the journal is crash recovery's source of truth,
+        and a committed-but-unfinalized trial must be reconstructible
+        from it alone (trial ids are content-addressed over the params,
+        so recovery can verify the round trip). The scheduler half of
+        info_dict rides along too — an ASHA promotion's rung/parent or a
+        PBT segment's member/generation must survive the crash, or the
+        re-run's FINAL would bookkeep into the wrong ledger slot;
+        dispatch-time keys (span/gang/partition/epoch) are rebuilt by
+        recovery itself and stay out."""
+        with trial.lock:
+            sched_info = {k: v for k, v in trial.info_dict.items()
+                          if k not in ("span", "gang", "partition", "epoch")}
+        span = self.telemetry.trial_event(trial.trial_id, "queued",
+                                          params=trial.params,
+                                          trial_type=trial.trial_type,
+                                          info=sched_info)
         if span is not None:
             with trial.lock:
                 trial.info_dict["span"] = span

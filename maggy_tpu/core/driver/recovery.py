@@ -1,0 +1,214 @@
+"""Crash-only driver recovery: rebuild control-plane state from the journal.
+
+The telemetry journal is the experiment's replayable source of truth
+(durable up to the FINAL-path barrier, torn-tail tolerant), and the split
+report/suggest optimizer contract makes controller state reconstructible
+from the FINAL stream — so a driver that dies mid-sweep restarts with
+``lagom(..., resume=True)``, replays its own journal, and continues
+instead of losing the run (ROADMAP item 2; the Podracer paper's
+crash-only controller design).
+
+What is REPLAYED vs RE-ADOPTED vs REQUEUED (docs/developer.md):
+
+- **Replayed** (this module, pure over journal events): the set of
+  committed-but-unfinalized trials, each with its params (journaled on
+  the ``queued`` edge), its last run epoch (journaled on the ``running``
+  edge), its last holding partition, and any preemption checkpoint step;
+  plus every partition the dead incarnation had registered, with its
+  capacity. Span state and the finalized half (trial.json artifacts +
+  ``restore_from_finals``) are restored by the driver before this runs.
+- **Re-adopted**: still-live runners. The server comes back on the same
+  secret and address (``driver_state.json``); a surviving runner's next
+  heartbeat / retried FINAL / GET re-binds it (journaled ``adopted``),
+  and a restarted runner agent reclaims its slot through the ordinary
+  JOIN resume path. A retried FINAL from the pre-crash incarnation is
+  accepted exactly once — the reconstructed trial carries its pre-crash
+  run epoch, so the stale-epoch guard passes until a post-recovery
+  requeue bumps it.
+- **Requeued**: trials on runners that died with (or after) the driver.
+  Recovery seeds the reservation table with the pre-crash assignments
+  and a FRESH liveness window; the ORDINARY slot-reclaim scan then
+  requeues a silent partition's trial exactly once. Recovery itself adds
+  no second requeue path.
+
+Everything here is a pure function over journal events plus one applier
+that writes into the driver's own stores under its own locks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class TrialFacts:
+    """Recovery facts about one trial, accumulated over its journal
+    events (oldest first)."""
+
+    __slots__ = ("trial_id", "params", "trial_type", "info", "queued_t",
+                 "finalized", "epoch", "partition", "resume_step")
+
+    def __init__(self, trial_id: str):
+        self.trial_id = trial_id
+        self.params: Optional[Dict[str, Any]] = None
+        self.trial_type: str = "optimization"
+        self.info: Dict[str, Any] = {}
+        self.queued_t: Optional[float] = None
+        self.finalized = False
+        self.epoch = 0
+        #: Partition holding the trial at the journal's end (None = the
+        #: attempt ended: requeued/lost/preempted and not re-dispatched).
+        self.partition: Optional[int] = None
+        self.resume_step: Optional[int] = None
+
+
+class ReplayedState:
+    """The journal's recovery-relevant content: per-trial facts plus the
+    dead incarnation's registered partitions (pid -> capacity)."""
+
+    def __init__(self):
+        self.trials: Dict[str, TrialFacts] = {}
+        self.partitions: Dict[int, Optional[int]] = {}
+
+    def inflight(self) -> List[TrialFacts]:
+        """Committed (``queued``) but never finalized, params known —
+        the trials recovery must put back into the schedule."""
+        return [f for f in self.trials.values()
+                if f.queued_t is not None and not f.finalized
+                and f.params is not None]
+
+
+def replay_recovery_state(events: List[Dict[str, Any]]) -> ReplayedState:
+    """Fold a (possibly multi-incarnation) journal into ReplayedState.
+    Pure — the same events always produce the same reconstruction."""
+    state = ReplayedState()
+    for ev in events:
+        kind = ev.get("ev")
+        if kind == "runner":
+            pid = ev.get("partition")
+            if pid is not None:
+                if ev.get("phase") == "registered":
+                    # Capacity rides ONLY the registered edge; later
+                    # runner events (a previous recovery's ``adopted``)
+                    # carry none and must not clobber it — a second
+                    # failover would otherwise restore elastic runners
+                    # capacity-less.
+                    state.partitions[int(pid)] = ev.get("capacity")
+                else:
+                    state.partitions.setdefault(int(pid), None)
+            continue
+        if kind != "trial":
+            continue
+        tid = ev.get("trial")
+        if not tid:
+            continue
+        facts = state.trials.get(tid)
+        if facts is None:
+            facts = state.trials[tid] = TrialFacts(tid)
+        phase = ev.get("phase")
+        if phase == "queued":
+            facts.queued_t = ev.get("t")
+            if ev.get("params") is not None:
+                facts.params = ev["params"]
+                facts.trial_type = ev.get("trial_type", "optimization")
+                facts.info = dict(ev.get("info") or {})
+        elif phase == "assigned":
+            if ev.get("partition") is not None:
+                facts.partition = int(ev["partition"])
+        elif phase == "running":
+            if ev.get("partition") is not None:
+                facts.partition = int(ev["partition"])
+            if ev.get("epoch") is not None:
+                facts.epoch = int(ev["epoch"])
+        elif phase in ("requeued", "lost"):
+            # The attempt ended; a later ``assigned`` re-sets the holder.
+            facts.partition = None
+        elif phase == "preempted":
+            facts.partition = None
+            if ev.get("step") is not None:
+                facts.resume_step = int(ev["step"])
+        elif phase == "finalized":
+            facts.finalized = True
+    return state
+
+
+def recover_optimization_driver(driver) -> Optional[Dict[str, Any]]:
+    """Apply the journal's replayed state to a freshly constructed
+    (resuming) OptimizationDriver: reconstruct the in-flight half of the
+    trial store, re-seed the reservation table with pre-crash partitions,
+    and queue an IDLE nudge per recovered partition so adopted-but-idle
+    runners get work without a REG. Returns the reconstruction stats for
+    the ``recovered`` journal event, or None when there is no journal to
+    replay (telemetry off — artifact-only legacy resume)."""
+    from maggy_tpu.trial import Trial
+
+    if driver.telemetry.journal is None:
+        return None
+    state = replay_recovery_state(driver.telemetry.events())
+    if not state.trials and not state.partitions:
+        return None
+    with driver._store_lock:
+        already_final = {t.trial_id for t in driver._final_store}
+    restored_inflight = 0
+    requeued = 0
+    held: Dict[int, str] = {}
+    for facts in state.inflight():
+        if facts.trial_id in already_final:
+            # The FINAL's trial.json landed but its journal edge was lost
+            # to the crash window: the artifact is authoritative — the
+            # trial is done, not in flight.
+            continue
+        trial = Trial(facts.params, trial_type=facts.trial_type)
+        if trial.trial_id != facts.trial_id:
+            # Params did not round-trip the journal faithfully (exotic
+            # non-JSON hparam types): reconstruction would mint a
+            # different schedule entry — skip it and let the seeded
+            # controller re-derive the config instead.
+            driver._log("recovery: journaled params for trial {} do not "
+                        "reproduce its id (got {}); leaving it to the "
+                        "controller to re-derive".format(
+                            facts.trial_id, trial.trial_id))
+            continue
+        with trial.lock:
+            trial.status = Trial.SCHEDULED
+            trial.run_epoch = facts.epoch
+            trial.info_dict.update(facts.info)
+            if facts.resume_step is not None:
+                trial.info_dict["resume_step"] = facts.resume_step
+            span = driver.telemetry.spans.span_id(trial.trial_id)
+            if span is not None:
+                trial.info_dict["span"] = span
+        with driver._store_lock:
+            driver._trial_store[trial.trial_id] = trial
+        restored_inflight += 1
+        if facts.partition is not None:
+            # The pre-crash holder: restore the assignment so a live
+            # runner's retried FINAL matches, and a dead one's silence
+            # requeues via the ordinary slot-reclaim scan.
+            held[facts.partition] = trial.trial_id
+        else:
+            with driver._store_lock:
+                if trial.trial_id not in driver._requeue:
+                    driver._requeue.append(trial.trial_id)
+            requeued += 1
+    res = driver.server.reservations
+    for pid, cap in sorted(state.partitions.items()):
+        res.restore(pid, trial_id=held.get(pid), capacity=cap)
+    for pid in sorted(set(held) - set(state.partitions)):
+        res.restore(pid, trial_id=held[pid])
+    # Idle nudge: an adopted-but-idle runner never re-REGs — it just
+    # keeps GET-polling — so nothing would ever assign it work. One IDLE
+    # message per recovered partition (drained once the worker starts)
+    # routes it through the ordinary assignment path; dead partitions'
+    # nudges park work on them at worst one liveness window before the
+    # loss scan reclaims it.
+    recovered_pids = sorted(set(state.partitions) | set(held))
+    for pid in recovered_pids:
+        if held.get(pid) is None:
+            driver.enqueue({"type": "IDLE", "partition_id": pid,
+                            "last_trial": None})
+    return {
+        "inflight": restored_inflight,
+        "held_partitions": len(held),
+        "backlogged": requeued,
+        "recovered_partitions": len(recovered_pids),
+    }

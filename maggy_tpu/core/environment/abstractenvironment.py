@@ -112,11 +112,14 @@ class AbstractEnv(ABC):
             s.close()
         return ip
 
-    def connect_host(self, server, host: Optional[str] = None):
+    def connect_host(self, server, host: Optional[str] = None,
+                     port: int = 0):
         """Bind the control-plane server and return (host, port). Platform
         implementations may additionally publish the address (the reference
-        POSTs it to Hopsworks REST, `hopsworks.py:129-178`)."""
-        return server.start(host=host or "127.0.0.1")
+        POSTs it to Hopsworks REST, `hopsworks.py:129-178`). ``port``
+        pins the bind (crash-only recovery rebinds the pre-crash port so
+        surviving runners' reconnects land); 0 = ephemeral."""
+        return server.start(host=host or "127.0.0.1", port=port)
 
     @staticmethod
     def str_or_byte(value):

@@ -265,6 +265,41 @@ class Reservations:
             if rec is not None:
                 rec["released"] = True
 
+    # ------------------------------------------------------- crash recovery
+
+    def restore(self, partition_id, trial_id: Optional[str] = None,
+                capacity: Optional[int] = None,
+                host_port: Optional[str] = None) -> None:
+        """Crash-only recovery: re-seed a pre-crash partition's record
+        from the replayed journal. The record starts with a FRESH
+        last_beat — every recovered partition gets exactly one liveness
+        window to prove itself: a still-live runner's next heartbeat /
+        retried FINAL re-binds it (``pop_recovered`` journals the
+        ``adopted`` edge), a dead one goes silent past the loss bound and
+        the ORDINARY slot-reclaim scan requeues its trial — recovery adds
+        no second requeue path. Never overwrites a live registration."""
+        with self.lock:
+            pid = int(partition_id)
+            if pid in self._table:
+                return
+            self._table[pid] = {
+                "partition_id": pid, "trial_id": trial_id,
+                "capacity": capacity, "host_port": host_port,
+                "task_attempt": 0, "recovered": True,
+                "last_beat": time.monotonic(),
+            }
+
+    def pop_recovered(self, partition_id) -> bool:
+        """Consume the partition's recovered flag: True exactly once, on
+        the first post-recovery message — the caller journals the
+        ``adopted`` runner edge on it."""
+        with self.lock:
+            rec = self._table.get(int(partition_id))
+            if rec is not None and rec.get("recovered"):
+                rec.pop("recovered", None)
+                return True
+            return False
+
     # ------------------------------------------------------------ gang holds
 
     def hold_for_gang(self, partition_id, trial_id: str) -> None:
@@ -1211,6 +1246,17 @@ class OptimizationServer(Server):
             LOG=self._log,
         )
 
+    def _note_adopted(self, partition_id) -> None:
+        """First post-recovery message from a pre-crash partition: the
+        runner survived the driver restart and re-bound (same secret,
+        same address) — journal the ``adopted`` runner edge exactly once
+        (the recovered flag is consumed)."""
+        if self.reservations.pop_recovered(partition_id):
+            telem = self.telemetry
+            if telem is not None:
+                telem.event("runner", phase="adopted",
+                            partition=int(partition_id))
+
     def _tick(self) -> None:
         if self.driver is None:
             return
@@ -1267,6 +1313,7 @@ class OptimizationServer(Server):
 
     def _metric(self, msg):
         self.reservations.touch(msg["partition_id"])
+        self._note_adopted(msg["partition_id"])
         telem = self.telemetry
         rstats = msg.pop("rstats", None)
         if rstats and telem is not None:
@@ -1307,7 +1354,25 @@ class OptimizationServer(Server):
         return {"type": "OK"}
 
     def _final(self, msg):
+        """FINAL dispatch wrapper: the durability barrier runs AFTER the
+        handler, BEFORE the reply is written (the dispatcher sends the
+        returned dict) — so the journal, crash recovery's source of
+        truth, can never trail a FINAL the runner saw acknowledged. On
+        the inline fast path the finalized span edge and trial.json are
+        both durable by the time the reply leaves; on the worker
+        fallback the FINAL is still queued when the reply is written —
+        a crash in that window re-runs the trial (at-least-once, never
+        lost), documented in docs/developer.md."""
+        try:
+            return self._final_unbarriered(msg)
+        finally:
+            telem = self.telemetry
+            if telem is not None:
+                telem.barrier()
+
+    def _final_unbarriered(self, msg):
         self.reservations.touch(msg["partition_id"])
+        self._note_adopted(msg["partition_id"])
         # Conditional, not assign_trial(None): a RETRIED final (severed /
         # lost reply) must not wipe the next trial assigned in between.
         self.reservations.clear_trial_if(msg["partition_id"],
@@ -1387,15 +1452,22 @@ class OptimizationServer(Server):
         if telem is not None:
             # "running" = the TRIAL reply leaves the driver: the hand-off
             # gap's closing edge (its opening edge is the previous trial's
-            # "finalized" on the same partition).
+            # "finalized" on the same partition). The run epoch rides
+            # along so crash recovery can reconstruct an in-flight
+            # trial's epoch — a pre-crash runner's retried FINAL then
+            # passes the stale-epoch guard (accepted exactly once), while
+            # a dead incarnation's FINAL after a post-recovery requeue
+            # (epoch bumped) still drops.
             telem.trial_event(trial.trial_id, "running",
-                              partition=int(partition_id))
+                              partition=int(partition_id),
+                              epoch=info.get("epoch"))
         return {"type": "TRIAL", "trial_id": trial.trial_id,
                 "params": trial.params, "info": info,
                 "span": info.get("span")}
 
     def _get(self, msg):
         self.reservations.touch(msg["partition_id"])
+        self._note_adopted(msg["partition_id"])
         pid = msg["partition_id"]
         if self.reservations.evict_requested(pid):
             # Fleet preemption of an idle (or between-trials) runner: hand

@@ -91,3 +91,13 @@ class RendezvousError(MaggyTPUError):
 
 class AuthenticationError(MaggyTPUError):
     """A control-plane message failed the shared-secret check."""
+
+
+class RunAdoptionError(MaggyTPUError):
+    """Another driver already adopted this run directory.
+
+    Crash-only recovery admits exactly ONE driver incarnation per run dir
+    at a time: adoption goes through an exclusive ``.driver_epoch.N``
+    marker (``util.claim_driver_epoch``), and the loser of a
+    two-restarting-drivers race gets this error instead of a second
+    control plane silently double-driving the same experiment."""

@@ -84,12 +84,12 @@ def _begin_run(config, env, exclusive: bool) -> _Submission:
         base = getattr(config, "experiment_dir", None) \
             or env.experiment_base_dir()
         if getattr(config, "resume", False):
-            run_id = util.next_run_id(base, app_id, env=env)
-            if run_id == 0:
-                raise ValueError(
-                    "resume=True but no previous run of app '{}' exists "
-                    "under {}".format(app_id, base))
-            run_id -= 1  # re-enter the most recent run's directory
+            # Re-enter the most recent run OF THIS EXPERIMENT (matched by
+            # registered name, not just position): one app id hosts many
+            # experiments in fleet mode, and the bare most-recent rule
+            # would adopt whichever tenant ran last.
+            run_id = util.find_resume_run_id(base, app_id,
+                                             name=config.name, env=env)
         else:
             run_id = util.claim_run_id(base, app_id, env=env)
         token = next(_token_counter)
@@ -173,12 +173,13 @@ def lagom_submit(train_fn: Callable, config: LagomConfig = None, *,
     ``FleetSubmission`` handle (``.result()``/``.done()``) so many
     experiments can be submitted before waiting on any."""
     config = _build_config(config, kwargs)
-    if getattr(config, "resume", False):
-        raise ValueError(
-            "resume=True is not supported through lagom_submit yet: "
-            "resume re-enters an existing run dir, which the fleet's "
-            "concurrent run-id claiming cannot arbitrate. Run the resume "
-            "through lagom().")
+    # resume=True re-enters the most recent run dir. Concurrent
+    # resubmissions racing for the same dir are arbitrated by the
+    # driver's exclusive incarnation marker (util.claim_driver_epoch):
+    # exactly one adopter wins; the loser's submission fails with
+    # RunAdoptionError through the handle — a resubmitted tenant after a
+    # driver crash recovers its run from the journal like lagom() does
+    # (docs/developer.md "Crash-only recovery").
     util.apply_platform_env()
     handle = fleet.submit(train_fn, config, priority=priority, weight=weight,
                           min_runners=min_runners, max_runners=max_runners,

@@ -775,7 +775,12 @@ class FleetAgent:
                         self._telemetry.event(
                             "agent", phase="lease", agent=self.agent_id,
                             exp=resp.get("exp"),
-                            pid=resp.get("partition_id"))
+                            pid=resp.get("partition_id"),
+                            # Warm prewarming hint: the experiment's
+                            # program-family key ABIND shipped — same
+                            # family as this process's last lease means
+                            # its warm slots (train/warm.py) stay hot.
+                            family=resp.get("family"))
                     error = self._serve(resp)
                     self.leases_served += 1
                     self.last_error = error

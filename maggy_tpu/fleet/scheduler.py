@@ -77,6 +77,16 @@ class FleetSaturated(RuntimeError):
     the queue drains."""
 
 
+def _base_name(name: str) -> str:
+    """A resubmitted tenant's scheduler name carries a ``-<seq>`` dedup
+    suffix (Fleet.submit); failover matching (parked gang blocks) keys
+    on the base name so the restarted submission finds its block."""
+    stem, sep, tail = name.rpartition("-")
+    if sep and tail.isdigit():
+        return stem
+    return name
+
+
 def priority_rank(priority) -> int:
     if isinstance(priority, str):
         try:
@@ -211,7 +221,8 @@ class FleetScheduler:
                  max_active: Optional[int] = None,
                  preempt_grace_s: float = 1.0,
                  max_queued: Optional[int] = None,
-                 max_size: Optional[int] = None):
+                 max_size: Optional[int] = None,
+                 tenant_grace_s: float = 10.0):
         self.fleet_size = int(fleet_size)
         # Upper bound the fleet can GROW to as remote agents join
         # (thread runners + agent slots). Gang feasibility checks
@@ -254,6 +265,19 @@ class FleetScheduler:
         # assemble an N-chip contiguous mesh slice out of fleet runners
         # without fair share starving the gang at N-1 members forever.
         self._gang_blocks: Dict[str, List[int]] = {}  # guarded-by: _lock
+        # Crash-only tenant failover: a FAILED tenant's gang block is
+        # PARKED for tenant_grace_s instead of redistributed — a driver
+        # restart (the resubmitted tenant, matched by base name) reclaims
+        # the same contiguous window instead of re-queueing behind every
+        # other experiment's block demand; expiry releases it to fair
+        # share. base-name -> (block, monotonic expiry).
+        self.tenant_grace_s = float(tenant_grace_s)
+        self._parked_blocks: Dict[str, Tuple[List[int], float]] = {}  # guarded-by: _lock
+        # Warm prewarming hints: agent slot -> the program-family key
+        # (the submission's dotted train-fn path) it last served — the
+        # binding pick prefers handing an agent a same-family experiment
+        # so its per-process warm slots (train/warm.py) stay hot.
+        self._slot_family: Dict[int, str] = {}  # guarded-by: _lock
         # Remote-agent runner slots (maggy_tpu.fleet.agent): indexes at
         # and above the thread-fleet size, allocated as agents join.
         # Vacant slots (their agent left/died) stay allocated — indexes
@@ -364,6 +388,11 @@ class FleetScheduler:
             # cross-process reuse (docs/user.md).
             "warm_start": bool(getattr(driver.config, "warm_start", True)),
             "train_fn": entry.train_fn_path,
+            # The experiment's program-family key (prewarming hints):
+            # the scheduler prefers re-leasing an agent to the family it
+            # last served, and the agent journals the key so warm-hint
+            # accuracy is auditable end to end.
+            "family": entry.train_fn_path,
         }
 
     def wait_admitted(self, entry: ExperimentEntry,
@@ -402,9 +431,22 @@ class FleetScheduler:
             self._event("fleet_experiment", exp=entry.name, phase=state)
             # A finished experiment's gang block must not park runners
             # forever (the driver normally releases it, but a crashed
-            # driver may not have).
-            if self._gang_blocks.pop(entry.name, None) is not None:
-                self._event("pack", op="fleet_release", exp=entry.name)
+            # driver may not have). A FAILED tenant — a crashed driver
+            # awaiting restart — keeps its block PARKED for the grace
+            # window instead: the resubmitted tenant reclaims the same
+            # contiguous window (crash-only failover) and only expiry
+            # redistributes it.
+            block = self._gang_blocks.pop(entry.name, None)
+            if block is not None:
+                if state == "failed" and self.tenant_grace_s > 0:
+                    self._parked_blocks[_base_name(entry.name)] = (
+                        block, time.monotonic() + self.tenant_grace_s)
+                    self._event("pack", op="fleet_park", exp=entry.name,
+                                block=block,
+                                grace_s=self.tenant_grace_s)
+                else:
+                    self._event("pack", op="fleet_release",
+                                exp=entry.name)
             # Retire the entry: late release_binding calls still work on
             # the object itself; only the scheduling/status sets forget
             # it. Keep a bounded tail of final snapshots for status.json.
@@ -439,10 +481,14 @@ class FleetScheduler:
 
     def agent_slot_detach(self, runner_idx: int) -> None:
         """The slot's agent left or was lost: the index stops counting
-        toward fair-share capacity until the next joiner reuses it."""
+        toward fair-share capacity until the next joiner reuses it. Its
+        warm-family hint dies with the process — the NEXT joiner reusing
+        this index is a fresh interpreter with cold slots, and a stale
+        hint would fake warmth."""
         with self._lock:
             if runner_idx in self._agent_slots:
                 self._vacant_agent_slots.add(runner_idx)
+                self._slot_family.pop(runner_idx, None)
                 self._targets_cache = None
                 self._wake.notify_all()
 
@@ -561,7 +607,25 @@ class FleetScheduler:
             existing = self._gang_blocks.get(entry.name)
             if existing is not None:
                 return list(existing)
+            self._expire_parked_locked()
+            # Crash-only failover: a restarted tenant reclaims the block
+            # its dead incarnation held (parked at finish("failed"))
+            # instead of re-competing for a window.
+            parked = self._parked_blocks.pop(_base_name(entry.name), None)
+            if parked is not None:
+                block = parked[0]
+                self._gang_blocks[entry.name] = block
+                self._event("pack", op="fleet_reclaim", exp=entry.name,
+                            block=block)
+                self._wake.notify_all()
+                return list(block)
             taken = {r for b in self._gang_blocks.values() for r in b}
+            # Parked blocks stay un-redistributable for the grace window:
+            # another tenant's gang must not squat the window a
+            # restarting driver is about to reclaim. (1-runner bindings
+            # still flow — only gang WINDOWS are shielded.)
+            taken |= {r for b, _exp in self._parked_blocks.values()
+                      for r in b}
             bound_elsewhere = set()
             for e in self._entries.values():
                 if e is not entry:
@@ -583,6 +647,18 @@ class FleetScheduler:
             if block is not None:
                 self._event("pack", op="fleet_release", exp=entry.name,
                             block=block)
+                self._wake.notify_all()
+
+    # locked-by: _lock
+    def _expire_parked_locked(self) -> None:
+        """Release parked blocks whose restart grace ran out — the dead
+        tenant never came back; its window returns to fair share."""
+        now = time.monotonic()
+        for base, (block, expiry) in list(self._parked_blocks.items()):
+            if now >= expiry:
+                del self._parked_blocks[base]
+                self._event("pack", op="fleet_release", exp=base,
+                            block=block, expired=True)
                 self._wake.notify_all()
 
     # locked-by: _lock
@@ -632,6 +708,8 @@ class FleetScheduler:
             return None
         targets = self._targets_locked()
         now = time.monotonic()
+        slot_family = self._slot_family.get(runner_idx) if is_agent \
+            else None
         best = None
         best_key = None
         for e in self._active.values():
@@ -643,12 +721,21 @@ class FleetScheduler:
                 continue
             if e.allocated() >= e.effective_max(self.fleet_size):
                 continue
+            # Warm prewarming hint: among equally-deserving (same
+            # deficit, same class) candidates, prefer the experiment
+            # whose program family this agent ALREADY holds warm slots
+            # for — a same-family re-lease skips the trace+compile cost
+            # entirely (train/warm.py). Ranked below deficit and class
+            # so warmth can never override fair share or priority.
+            cold = 0 if (slot_family is not None
+                         and e.train_fn_path == slot_family) else 1
             key = (e.allocated() - targets.get(e.name, 0),
-                   e.policy.rank, e.vtime(now), e.seq)
+                   e.policy.rank, cold, e.vtime(now), e.seq)
             if best_key is None or key < best_key:
                 best, best_key = e, key
         return best
 
+    # locked-by: _lock
     def _lease_locked(self, runner_idx: int,
                       entry: ExperimentEntry) -> Tuple[ExperimentEntry, int]:
         pid = min(entry.free_pids)
@@ -658,8 +745,19 @@ class FleetScheduler:
         entry.deficit_since = None
         if entry.first_lease_t is None:
             entry.first_lease_t = time.time()
+        # Warm prewarming hint bookkeeping (agent slots only: warm slots
+        # are per-process, and only agents persist across leases):
+        # warm_hint=True means this lease lands on an agent that already
+        # holds the experiment's program family warm.
+        warm_hint = None
+        if runner_idx in self._agent_slots \
+                and entry.train_fn_path is not None:
+            warm_hint = self._slot_family.get(runner_idx) \
+                == entry.train_fn_path
+            self._slot_family[runner_idx] = entry.train_fn_path
         self._event("lease", exp=entry.name, runner=runner_idx, pid=pid,
-                    phase="start", exp_dir=entry.exp_dir)
+                    phase="start", exp_dir=entry.exp_dir,
+                    warm_hint=warm_hint)
         return entry, pid
 
     def release_binding(self, runner_idx: int, entry: ExperimentEntry,
@@ -705,6 +803,7 @@ class FleetScheduler:
         with self._lock:
             if self.stopped:
                 return 0
+            self._expire_parked_locked()
             targets = self._targets_locked()
             for e in self._active.values():
                 if not e.wants_runners():
@@ -1359,6 +1458,11 @@ def replay_fleet_journal(path: str, env=None,
     agent_leases: Dict[str, int] = {}
     abind_ms: List[float] = []
     agent_lost_leases = 0
+    # Warm prewarming hints: how many agent-slot leases landed on an
+    # agent already holding the experiment's program family warm
+    # (lease-event warm_hint field; None = thread runner / family-less).
+    warm_hint_hits = 0
+    warm_hint_misses = 0
     # Journal-sink ingest records (jsink) + per-agent clock offsets —
     # the telemetry fan-in plane's replayable numbers.
     sink_batches = 0
@@ -1404,6 +1508,10 @@ def replay_fleet_journal(path: str, env=None,
                 e["open"][key] = t
                 if e["first_lease_t"] is None:
                     e["first_lease_t"] = t
+                if ev.get("warm_hint") is True:
+                    warm_hint_hits += 1
+                elif ev.get("warm_hint") is False:
+                    warm_hint_misses += 1
             elif ev.get("phase") == "end":
                 t0 = e["open"].pop(key, None)
                 if t0 is not None and t is not None:
@@ -1510,6 +1618,10 @@ def replay_fleet_journal(path: str, env=None,
             "leases": sum(agent_leases.values()),
             "per_agent_leases": dict(sorted(agent_leases.items())),
             "abind_ms": _dist_stats(abind_ms),
+            # Prewarming-hint accuracy: agent leases that landed on an
+            # already-warm family vs cold re-binds.
+            "warm_hint_hits": warm_hint_hits,
+            "warm_hint_misses": warm_hint_misses,
         },
         # Journal-sink ingest (empty/zero when no tenant/agent shipped).
         "sink": {

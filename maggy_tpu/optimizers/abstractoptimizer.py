@@ -136,6 +136,26 @@ class AbstractOptimizer(ABC):
         sampling buffers / rebuild bookkeeping. Default: rely on
         final_store alone."""
 
+    def restore_from_finals(self, finalized: List[Trial],
+                            inflight: List[Trial] = ()) -> None:
+        """Crash-only driver recovery: rebuild this controller's state by
+        re-playing the journal's FINAL stream through the SPLIT contract.
+        Default, built on report()/recycle() semantics: ``restore`` runs
+        over finalized PLUS in-flight trials — buffer-backed samplers
+        must drop the in-flight configs too, since the driver already
+        reconstructed those Trial objects and a re-suggested duplicate
+        would collide in the store — then every finalized trial is
+        ``report()``ed in completion order, exactly the bookkeeping the
+        live FINAL path would have done. Prefetched-but-undispatched
+        suggestions died with the crashed process: nothing recycles them
+        here; they were never committed (no ``queued`` edge), so the
+        fresh controller simply re-derives them. Controllers whose
+        ``restore`` already rebuilds the same ledgers ``report`` writes
+        (ASHA rungs, PBT chains) override this to avoid double entry."""
+        self.restore(list(finalized) + list(inflight))
+        for trial in finalized:
+            self.report(trial)
+
     @staticmethod
     def _drop_executed(buffer: List[dict], finalized: List[Trial]) -> List[dict]:
         """Filter a config buffer down to configs the previous run did NOT

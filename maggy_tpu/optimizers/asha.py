@@ -166,6 +166,33 @@ class Asha(AbstractOptimizer):
             if parent is not None and rung > 0:
                 self.promoted.setdefault(rung - 1, []).append(parent)
 
+    def restore_from_finals(self, finalized, inflight=()) -> None:
+        """Crash-only recovery: ``restore`` already rebuilds exactly the
+        ledgers ``report`` writes (rungs) plus the promoted ledger report
+        never touches — re-reporting on top would double-enter every
+        rung. What restore alone missed is report's DONE decision: a
+        survivor that reached the top rung before the crash must leave
+        the restored controller exhausted, or the resumed sweep would
+        keep promoting past its own finish line. In-flight trials (a
+        reconstructed promotion child counts its parent as promoted via
+        its own info) need no buffer work — sampling is count-based over
+        the stores the driver already repopulated."""
+        self.restore(finalized)
+        for t in inflight:
+            # An in-flight PROMOTED child was committed by the dead
+            # incarnation's suggest(): its parent must re-enter the
+            # promoted ledger, or _promotable would re-promote the
+            # parent into a duplicate child.
+            parent = t.info_dict.get("parent")
+            rung = t.info_dict.get("rung", 0)
+            if parent is not None and rung > 0 \
+                    and parent not in self.promoted.get(rung - 1, []):
+                self.promoted.setdefault(rung - 1, []).append(parent)
+        if any(t.info_dict.get("rung", 0) >= self.max_rung
+               for t in finalized if t.final_metric is not None):
+            self._exhausted = True
+            self.schedule_version += 1
+
     def _lookup_params(self, trial_id: str) -> dict:
         for t in self.final_store:
             if t.trial_id == trial_id:

@@ -276,3 +276,18 @@ class PBT(AbstractOptimizer):
         for t in latest.values():
             if t.info_dict.get("generation", 0) + 1 < self.generations:
                 self._pending.append(self._next_segment(t))
+
+    def restore_from_finals(self, finalized, inflight=()) -> None:
+        """Crash-only recovery: ``restore`` already re-derives each
+        member's next segment from its last finalized generation — the
+        exact segments ``report`` would have appended — so re-reporting
+        on top would double-append every chain link. In-flight segments
+        the driver reconstructed from the journal ARE those successors
+        (same member, same generation, same content-addressed id):
+        drop them from the pending queue, or the chain would run its
+        next link twice."""
+        self.restore(finalized)
+        have = {t.trial_id for t in inflight}
+        if have:
+            self._pending = [p for p in self._pending
+                             if p.trial_id not in have]

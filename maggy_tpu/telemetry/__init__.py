@@ -46,7 +46,8 @@ class Telemetry:
 
     def __init__(self, env=None, journal_path: Optional[str] = None,
                  enabled: bool = True, flush_interval_s: float = 1.0,
-                 sink=None, sink_source: Optional[str] = None):
+                 sink=None, sink_source: Optional[str] = None,
+                 fsync: Optional[bool] = None):
         self.enabled = enabled
         self.metrics = MetricsRegistry()
         self.spans = SpanTracker()
@@ -65,7 +66,8 @@ class Telemetry:
                     metrics_fn=self.metrics.snapshot)
             else:
                 self.journal = TelemetryJournal(
-                    env, journal_path, flush_interval_s=flush_interval_s)
+                    env, journal_path, flush_interval_s=flush_interval_s,
+                    fsync=fsync)
         # Journal-less fallback buffer (no env/path given): spans still
         # derive for the TELEM verb, just without persistence.
         self._local_lock = threading.Lock()
@@ -272,11 +274,44 @@ class Telemetry:
             snap["health"] = self.health.snapshot()
         return snap
 
+    def restore_spans(self) -> int:
+        """Rebuild the span tracker from the journal's restored trial
+        events (crash-only recovery / resume): each trial keeps its
+        pre-crash span id and first-occurrence phase timestamps, so the
+        recovered driver's later phase events continue the SAME spans —
+        and ``once=True`` dedup (stop_sent, prefetch hit/miss, compiled)
+        holds across incarnations. Returns the number of trial events
+        replayed into the tracker."""
+        if not self.enabled:
+            return 0
+        n = 0
+        for ev in self.events():
+            if ev.get("ev") != "trial" or not ev.get("trial"):
+                continue
+            self.spans.restore(ev["trial"], ev.get("span"),
+                               ev.get("phase"), ev.get("t"),
+                               partition=ev.get("partition"))
+            n += 1
+        return n
+
     # ------------------------------------------------------------ lifecycle
 
     def flush(self) -> None:
         if self.journal is not None:
             self.journal.flush()
+
+    def barrier(self) -> None:
+        """Terminal-event durability barrier (crash-only recovery): make
+        the buffered journal suffix durable NOW — called by the FINAL
+        path before its RPC reply is written, so an acknowledged FINAL
+        can never be absent from the recovery source of truth. Journals
+        that own no local durability (the fleet sink's SinkJournal ships
+        at-least-once with a local fallback spool) expose no barrier and
+        are a no-op here."""
+        j = self.journal
+        b = getattr(j, "barrier", None)
+        if b is not None:
+            b()
 
     def close(self) -> None:
         if self.journal is not None:

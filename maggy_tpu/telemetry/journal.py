@@ -43,6 +43,38 @@ FLUSHER_THREAD_NAME = "telemetry-flush"
 #: or <= 0 disables rotation.
 ROTATE_ENV = "MAGGY_TPU_JOURNAL_MAX_MB"
 
+#: Env var arming fsync durability (``1``/``true``): the journal fsyncs
+#: on segment SEAL and on ``barrier()`` (the terminal-event flush the
+#: FINAL path runs before its RPC reply) — never on the periodic flusher
+#: tick. Off by default: the flusher's cadence already bounds loss to
+#: ~1 s of TAIL events, and crash-only recovery tolerates a torn tail
+#: line by design (docs/telemetry.md, "torn-tail tolerance"). Chaos
+#: ``kill_driver`` soaks turn it on so an acknowledged FINAL can never
+#: be lost to the page cache.
+FSYNC_ENV = "MAGGY_TPU_JOURNAL_FSYNC"
+
+
+def _resolved_fsync(fsync) -> bool:
+    if fsync is not None:
+        return bool(fsync)
+    return os.environ.get(FSYNC_ENV, "").strip().lower() in ("1", "true",
+                                                             "on", "yes")
+
+
+def _fsync_path(path: str) -> None:
+    """Best-effort fsync of a local file (object-store backends have no
+    fd to sync — their dump() durability is the PUT's)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
 
 def _segment_path(path: str, index: int) -> str:
     return "{}.{:06d}".format(path, index)
@@ -63,12 +95,17 @@ def _resolved_max_bytes(max_mb: Optional[float]) -> Optional[int]:
 class TelemetryJournal:
     def __init__(self, env, path: str, flush_interval_s: float = 1.0,
                  max_mb: Optional[float] = None,
-                 start_flusher: bool = True):
+                 start_flusher: bool = True,
+                 fsync: Optional[bool] = None):
         self.env = env
         self.path = path
         self.flush_interval_s = flush_interval_s
         #: Active-file rotation threshold in bytes; None = never rotate.
         self._max_bytes = _resolved_max_bytes(max_mb)
+        #: Durability knob (MAGGY_TPU_JOURNAL_FSYNC / fsync=): fsync on
+        #: segment seal and on barrier() only — the periodic flusher
+        #: never pays it.
+        self._fsync = _resolved_fsync(fsync)
         self._lock = threading.Lock()
         # Serializes whole flush cycles (read-suffix -> write -> advance
         # _flushed): a finalize-path flush() racing the flusher thread's
@@ -168,8 +205,21 @@ class TelemetryJournal:
         with self._flush_lock:
             self._flush_locked()
 
+    def barrier(self) -> None:
+        """Durability barrier for terminal events (crash-only recovery):
+        flush the buffered suffix NOW — and fsync it when the durability
+        knob is armed — so the journal, the recovery source of truth, can
+        never trail an event the caller is about to acknowledge on the
+        wire (the FINAL path runs this before its RPC reply is written).
+        Without fsync the barrier still moves the events out of process
+        memory into the page cache: a driver crash (the fault being
+        defended against) cannot lose them; only a whole-host power loss
+        can, which is what the fsync knob buys."""
+        with self._flush_lock:
+            self._flush_locked(fsync=self._fsync)
+
     # locked-by: _flush_lock
-    def _flush_locked(self) -> None:
+    def _flush_locked(self, fsync: bool = False) -> None:
         with self._lock:
             if not self._dirty:
                 return
@@ -190,6 +240,8 @@ class TelemetryJournal:
                 with self._lock:
                     self._flushed = max(self._flushed, total)
                 self._active_bytes += len(payload)
+                if fsync:
+                    _fsync_path(self.path)
                 self._maybe_rotate(total)
                 return
             except Exception:  # noqa: BLE001 - backend without append
@@ -210,6 +262,8 @@ class TelemetryJournal:
             with self._lock:
                 self._flushed = max(self._flushed, total)
             self._active_bytes = len(payload)
+            if fsync:
+                _fsync_path(self.path)
             self._maybe_rotate(total)
         except Exception:  # noqa: BLE001 - telemetry must never fail a run
             with self._lock:
@@ -238,6 +292,11 @@ class TelemetryJournal:
             # window — the same old-or-new granularity bound the
             # unrotated journal already accepts for its tail line.
             self.env.dump(payload, segment)
+            if self._fsync:
+                # Seal durability (the fsync knob's other half): a sealed
+                # segment is immutable recovery input — it must survive a
+                # host crash, not just a process one.
+                _fsync_path(segment)
             self.env.dump("", self.path)
         except Exception:  # noqa: BLE001 - telemetry must never fail a run
             try:

@@ -118,6 +118,27 @@ class SpanTracker:
                 span.partition = int(partition)
             return span.span_id, first
 
+    def restore(self, trial_id: str, span_id: Optional[str], phase: str,
+                t: Optional[float], partition: Optional[int] = None) -> None:
+        """Rebuild one journaled phase occurrence into the tracker
+        (crash-only recovery / resume): the span keeps its ORIGINAL
+        journaled id — a recovered trial's later phases must land on the
+        same span the pre-crash events named, or the journal would carry
+        two spans for one trial — and first-occurrence timestamps are
+        preserved (setdefault, like mark). ``once=True`` emit dedup then
+        works across incarnations for free: a phase the dead incarnation
+        already journaled is not first on the restored span."""
+        if t is None:
+            return
+        with self._lock:
+            span = self._spans.get(trial_id)
+            if span is None:
+                span = TrialSpan(span_id or pysecrets.token_hex(6), trial_id)
+                self._spans[trial_id] = span
+            span.phases.setdefault(phase, t)
+            if partition is not None:
+                span.partition = int(partition)
+
     def all(self) -> List[Dict[str, Any]]:
         with self._lock:
             return [s.to_dict() for s in self._spans.values()]

@@ -85,6 +85,11 @@ EVENT_KINDS = frozenset({
                               #   rtt_s — Cristian's algorithm over the
                               #   AJOIN/ALEASE exchange; journaled
                               #   fleet-side per agent and agent-side)
+    "driver_epoch",           # driver incarnation boundary: a (re)started
+                              #   driver journals the epoch it claimed via
+                              #   util.claim_driver_epoch — the seam
+                              #   crash-only recovery and invariant 13
+                              #   split a multi-incarnation journal on
 })
 
 #: ``reason=`` on a trial ``requeued`` phase: why it re-entered the
@@ -104,8 +109,14 @@ REQUEUE_REASONS = frozenset({
 PROFILE_REASONS = frozenset({"manual", "auto"})
 
 #: ``phase=`` per non-trial event kind.
-EXPERIMENT_PHASES = frozenset({"start", "resumed", "finalized", "end"})
-RUNNER_PHASES = frozenset({"registered"})
+#: ``recovered`` = crash-only recovery rebuilt the control plane from
+#: the journal (trial store + reservations + controller state); fields
+#: carry the reconstruction counts (inflight, adopted_partitions, ...).
+EXPERIMENT_PHASES = frozenset({"start", "resumed", "recovered",
+                               "finalized", "end"})
+#: ``adopted`` = a pre-crash runner's first message re-bound it to the
+#: restarted driver (JOIN resume path / heartbeat / retried FINAL).
+RUNNER_PHASES = frozenset({"registered", "adopted"})
 WORKER_PHASES = frozenset({"registered", "finalized"})
 FLEET_PHASES = frozenset({"start", "stop"})
 #: fleet_experiment mirrors the scheduler entry states.
@@ -147,6 +158,14 @@ CHAOS_KINDS = frozenset({
     # per event id, zero experiment failures). Harness-injected: the
     # sink is fleet infrastructure, not an experiment-plan target.
     "kill_sink",
+    # Driver soak (chaos/driver_soak.py run_driver_soak): the DRIVER
+    # process SIGKILLed mid-sweep and restarted with resume — invariant
+    # 13 (journal replay rebuilds the control plane; no trial lost, no
+    # duplicate FINAL, completed trials never re-run, the sweep
+    # completes on survivors). Harness-injected: the fault kills the
+    # process that owns the chaos engine, so no in-process plan can
+    # record it — the soak appends the record to the quiesced journal.
+    "kill_driver",
 })
 
 #: Health-engine event fields (``ev: "health"``).

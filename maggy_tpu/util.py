@@ -126,6 +126,39 @@ def next_run_id(base_dir: str, app_id: str, env=None) -> int:
 RUN_CLAIM_FILE = ".run_claim"
 
 
+def find_resume_run_id(base_dir: str, app_id: str, name: str,
+                       env=None) -> int:
+    """The run id ``resume=True`` should re-enter: the MOST RECENT run of
+    this app whose registered experiment NAME matches ``name``.
+
+    The bare most-recent-run rule is wrong the moment one app id hosts
+    more than one experiment (fleet tenants share the process app id):
+    a resubmitted tenant would re-enter whichever tenant ran LAST and
+    replay someone else's journal. The experiment name in each run dir's
+    experiment.json is the identity that disambiguates; runs whose
+    metadata is missing/torn are skipped (never adopted blind). Raises
+    ``ValueError`` when no matching run exists."""
+    from maggy_tpu.core.environment import EnvSing
+
+    env = env or EnvSing.get_instance()
+    base = base_dir.rstrip("/")
+    last = next_run_id(base, app_id, env=env) - 1
+    for i in range(last, -1, -1):
+        meta_path = "{}/{}_{}/experiment.json".format(base, app_id, i)
+        if not env.exists(meta_path):
+            continue
+        try:
+            meta = json.loads(env.load(meta_path))
+        except ValueError:
+            continue
+        if meta.get("name") == name:
+            return i
+    raise ValueError(
+        "resume=True but no previous run of app '{}' named '{}' exists "
+        "under {} ({} run dir(s) scanned)".format(app_id, name, base,
+                                                  last + 1))
+
+
 def claim_run_id(base_dir: str, app_id: str, env=None) -> int:
     """Atomically claim the next free run id: scan like ``next_run_id``,
     then stake the run dir with ``AbstractEnv.exclusive_create`` (hard-link
@@ -151,6 +184,52 @@ def claim_run_id(base_dir: str, app_id: str, env=None) -> int:
             if env.exclusive_create(payload, marker):
                 return i
         i += 1
+
+
+#: Prefix of the per-incarnation adoption markers a driver stakes inside
+#: its run dir (see claim_driver_epoch).
+DRIVER_EPOCH_PREFIX = ".driver_epoch."
+
+
+def claim_driver_epoch(run_dir: str, env=None) -> int:
+    """Atomically claim the next driver incarnation of ``run_dir``.
+
+    Crash-only recovery lets a restarted driver re-enter an existing run
+    dir (``resume=True``) — but the resume SCAN in ``next_run_id`` is
+    racy by construction, so two restarting drivers can both decide to
+    adopt the same run. The ``.run_claim`` marker cannot arbitrate that
+    (it already exists — it belongs to the CRASHED incarnation), so
+    adoption goes through its own exclusive marker: scan for the highest
+    existing ``.driver_epoch.N``, then ``exclusive_create`` N+1. Exactly
+    one adopter wins each epoch; the loser gets ``RunAdoptionError`` (a
+    clear exit). Scope: this arbitrates CONCURRENT adopters racing for
+    the same epoch — a predecessor that claimed earlier and wedged
+    without exiting is instead caught by the resume port rebind (a
+    still-bound pre-crash port refuses adoption; Driver.init). Fresh
+    runs claim epoch 1 the same way — their run dir was staked
+    exclusively by ``claim_run_id``, so the claim cannot race.
+
+    Returns the claimed epoch (1-based)."""
+    import threading
+
+    from maggy_tpu.core.environment import EnvSing
+    from maggy_tpu.exceptions import RunAdoptionError
+
+    env = env or EnvSing.get_instance()
+    run_dir = run_dir.rstrip("/")
+    epoch = 1
+    while env.exists("{}/{}{}".format(run_dir, DRIVER_EPOCH_PREFIX, epoch)):
+        epoch += 1
+    payload = json.dumps({"claimed_at": time.time(), "pid": os.getpid(),
+                          "thread": threading.get_ident()})
+    marker = "{}/{}{}".format(run_dir, DRIVER_EPOCH_PREFIX, epoch)
+    if not env.exclusive_create(payload, marker):
+        raise RunAdoptionError(
+            "run dir {} was adopted by another driver (incarnation marker "
+            "{} already claimed); exactly one restarted driver may adopt "
+            "a run — this one must exit".format(run_dir,
+                                                marker.rsplit("/", 1)[-1]))
+    return epoch
 
 
 def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:

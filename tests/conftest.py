@@ -82,6 +82,14 @@ def pytest_configure(config):
         "-m scale.")
     config.addinivalue_line(
         "markers",
+        "failover: crash-only driver failover tests (core/driver/"
+        "recovery.py, chaos/driver_soak.py) — journal-replay "
+        "reconstruction, cross-incarnation RPC acceptance, run-dir "
+        "adoption, the FINAL-path durability barrier, and invariant 13. "
+        "The real-subprocess kill_driver soak is additionally marked "
+        "slow. Select with -m failover.")
+    config.addinivalue_line(
+        "markers",
         "sink: fleet-wide telemetry fan-in tests (maggy_tpu.telemetry."
         "sink) — the JSINK journal sink service, client shipper "
         "degrade/re-ship exactly-once seam (invariant 12), clock-offset "

@@ -635,7 +635,8 @@ class TestAgentScheduling:
         assert info == {"secret": "s3cret", "hb_interval": 0.5,
                         "exp_dir": "/tmp/x", "optimization_key": "metric",
                         "trial_type": "optimization",
-                        "warm_start": False, "train_fn": "m.mod:fn"}
+                        "warm_start": False, "train_fn": "m.mod:fn",
+                        "family": "m.mod:fn"}
         entry.train_fn_path = None
         assert FleetScheduler._build_agent_info(entry, _Drv()) is None
 
