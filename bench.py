@@ -774,6 +774,41 @@ def chaos_main():
     return 0 if report["ok"] else 1
 
 
+def _finalized_ids(events):
+    """Finalized trial ids of a journal (content-addressed over params,
+    so two runs of the same seeded schedule produce identical sets)."""
+    return sorted({ev["trial"] for ev in events
+                   if ev.get("ev") == "trial"
+                   and ev.get("phase") == "finalized"})
+
+
+def journal_schedule_parity(events_a, events_b,
+                            label_a="a", label_b="b"):
+    """Journal-replayed A/B schedule comparator — the ONE home of the
+    same-platform-baseline parity rule (ROADMAP flaky-TPU note): two
+    arms of an A/B (``--fork`` forking-on vs forking-off), or a
+    recovered run vs an uninterrupted reference (``--failover``),
+    executed the SAME schedule exactly when their finalized trial-id
+    sets match. Returns {match, <label_a>, <label_b>,
+    symmetric_difference}."""
+    ids_a, ids_b = _finalized_ids(events_a), _finalized_ids(events_b)
+    return {"match": ids_a == ids_b,
+            label_a: len(ids_a), label_b: len(ids_b),
+            "symmetric_difference": sorted(set(ids_a) ^ set(ids_b))}
+
+
+def rung0_events(events):
+    """Restrict a journal to its RUNG-0 trials' events — the seeded base
+    schedule. An ASHA A/B whose arms differ in trial DURATION (forking
+    on vs off) can legitimately top the ladder at different wall times,
+    so the promotion TAIL is timing-dependent; the rung-0 sample set is
+    the seed-deterministic half schedule parity is well-defined over."""
+    rung0 = {ev["trial"] for ev in events
+             if ev.get("ev") == "trial" and ev.get("phase") == "queued"
+             and (ev.get("info") or {}).get("rung", 0) == 0}
+    return [ev for ev in events if ev.get("trial") in rung0]
+
+
 def failover_main():
     """``bench.py --failover``: crash-only driver failover gate (see
     maggy_tpu/chaos/driver_soak.py). Runs the kill_driver soak — a real
@@ -832,20 +867,17 @@ def failover_main():
                                           JOURNAL_NAME))
     soak_events = read_events(report["journal"])
 
-    def _finalized_ids(events):
-        return sorted({ev["trial"] for ev in events
-                       if ev.get("ev") == "trial"
-                       and ev.get("phase") == "finalized"})
-
-    soak_ids, ref_ids = _finalized_ids(soak_events), _finalized_ids(
-        ref_events)
-    parity = soak_ids == ref_ids
+    parity_rec = journal_schedule_parity(soak_events, ref_events,
+                                         label_a="soak_trials",
+                                         label_b="reference_trials")
+    parity = parity_rec["match"]
     if not parity:
         violations.append(
             "replayed-vs-live parity broken: recovered sweep finalized {} "
             "trial(s), uninterrupted run {} — symmetric difference "
-            "{}".format(len(soak_ids), len(ref_ids),
-                        sorted(set(soak_ids) ^ set(ref_ids))))
+            "{}".format(parity_rec["soak_trials"],
+                        parity_rec["reference_trials"],
+                        parity_rec["symmetric_difference"]))
     ok = not violations
     print(json.dumps({
         "metric": "driver failover (SIGKILL x{} + journal-replay "
@@ -865,10 +897,217 @@ def failover_main():
             "adopted": report["failover"]["adopted"],
             "requeued": report["trials"]["requeued"],
             "recoveries": report["failover"]["recoveries"],
-            "parity": {"match": parity, "soak_trials": len(soak_ids),
-                       "reference_trials": len(ref_ids)},
+            "parity": parity_rec,
             "witness": report.get("witness"),
             "journal": report["journal"],
+        }},
+    }), flush=True)
+    return 0 if ok else 1
+
+
+def fork_main():
+    """``bench.py --fork``: the checkpoint-forking A/B gate (ROADMAP
+    item 3). The SAME fixed ASHA sweep runs twice on the SAME platform —
+    forking ON (config.fork, the default) vs OFF (from-scratch
+    promotions) — and the gate asserts:
+
+    (a) top-rung re-trained steps drop by >= the rung ratio: with
+        forking OFF every top-rung trial re-trains its parent's whole
+        prefix; with forking ON it resumes past it (re-trained ~0);
+    (b) exact step-for-step loss parity: every forked trial's recorded
+        trajectory equals a from-checkpoint continuation of its parent
+        (the trial body is a closed form of (lr, step), so equality is
+        bitwise — a fork that silently restarted or loaded the wrong
+        step cannot pass);
+    (c) trials/hour improves (wall_off / wall_on > 1), and both arms
+        executed the IDENTICAL schedule (journal_schedule_parity — the
+        same-platform-baseline rule shared with --failover).
+
+    Always CPU-pinned (closed-form trial body; the fake accelerator adds
+    nothing) with detail.platform recorded per the ROADMAP flaky-TPU
+    comparability note. Exit 1 on any gate failure."""
+    if "MAGGY_TPU_BASE_DIR" not in os.environ:
+        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    for var in _ACCEL_BOOTSTRAP_VARS:
+        os.environ.pop(var, None)
+    _force_cpu_if_requested()
+    import glob as _glob
+
+    from maggy_tpu import OptimizationConfig, Searchspace, experiment
+    from maggy_tpu.chaos.harness import (fork_ckpt_train_fn,
+                                         fork_step_metric)
+    from maggy_tpu.optimizers import Asha
+    from maggy_tpu.telemetry import (JOURNAL_NAME, read_events,
+                                     replay_journal)
+
+    seed = int(os.environ.get("BENCH_FORK_SEED", "7"))
+    trials = int(os.environ.get("BENCH_FORK_TRIALS", "9"))
+    rf = int(os.environ.get("BENCH_FORK_RF", "3"))
+    workers = int(os.environ.get("BENCH_FORK_WORKERS", "3"))
+    steps_per_budget = 4  # fork_ckpt_train_fn's contract
+    t_start = time.time()
+    arms = {}
+    for arm, fork_on in (("fork", True), ("scratch", False)):
+        arm_dir = os.path.join(os.environ["MAGGY_TPU_BASE_DIR"],
+                               "fork_ab_{}".format(arm))
+        config = OptimizationConfig(
+            name="bench_fork_{}".format(arm), num_trials=trials,
+            optimizer=Asha(reduction_factor=rf, resource_min=1,
+                           resource_max=rf * rf, seed=seed),
+            searchspace=Searchspace(lr=("DOUBLE", [0.05, 0.2])),
+            direction="max", num_workers=workers, hb_interval=0.02,
+            es_policy="none", seed=seed, fork=fork_on,
+            experiment_dir=arm_dir)
+        t0 = time.time()
+        experiment.lagom(fork_ckpt_train_fn, config)
+        wall = time.time() - t0
+        exp_dir = sorted(d for d in _glob.glob(os.path.join(arm_dir, "*"))
+                         if os.path.isdir(d))[-1]
+        events = read_events(os.path.join(exp_dir, JOURNAL_NAME))
+        trial_dicts = []
+        for td in _glob.glob(os.path.join(exp_dir, "*", "trial.json")):
+            with open(td) as f:
+                trial_dicts.append(json.load(f))
+        arms[arm] = {
+            "wall_s": round(wall, 2), "events": events,
+            "trials": trial_dicts,
+            "derived": replay_journal(os.path.join(exp_dir, JOURNAL_NAME)),
+        }
+        log("{} arm: {} trials in {:.1f}s (fork block: {})".format(
+            arm, len(trial_dicts), wall,
+            arms[arm]["derived"].get("fork")))
+
+    violations = []
+
+    def _fork_steps(events):
+        """trial -> forked step from the journal's genealogy edges."""
+        return {ev["trial"]: ev.get("step") for ev in events
+                if ev.get("ev") == "trial"
+                and ev.get("phase") == "forked_from"}
+
+    def _retrained_top_rung(arm):
+        """Sum over top-rung trials of the parent-prefix steps the trial
+        RE-TRAINED: the whole prefix when dispatched from scratch, the
+        part below its fork point when forked (0 at the fork default —
+        the fork point is the parent's last step)."""
+        info_of = {t["id"]: t.get("info_dict") or {}
+                   for t in arms[arm]["trials"]}
+        top = max((i.get("rung", 0) for i in info_of.values()), default=0)
+        forked_at = _fork_steps(arms[arm]["events"])
+        total = 0
+        n = 0
+        for tid, info in info_of.items():
+            if info.get("rung", 0) != top or info.get("parent") is None:
+                continue
+            n += 1
+            parent_budget = (rf ** (top - 1)) * 1
+            parent_steps = steps_per_budget * parent_budget
+            resume_offset = forked_at.get(tid)
+            executed_from = 0 if resume_offset is None else resume_offset + 1
+            total += max(0, parent_steps - executed_from)
+        return total, n, top
+
+    retrained_fork, n_top_fork, top_rung = _retrained_top_rung("fork")
+    retrained_scratch, n_top_scratch, _ = _retrained_top_rung("scratch")
+    if n_top_fork == 0 or n_top_scratch == 0:
+        violations.append("no top-rung promotions ran: the sweep never "
+                          "climbed the ladder (nothing gated)")
+    elif retrained_fork * rf > retrained_scratch:
+        violations.append(
+            "top-rung re-trained steps did not drop by the rung ratio: "
+            "forking-on re-trained {} steps vs {} forking-off "
+            "(needed <= {}/{} = {})".format(
+                retrained_fork, retrained_scratch, retrained_scratch,
+                rf, retrained_scratch / rf))
+
+    # (b) exact fork parity: each forked trial's recorded trajectory ==
+    # the from-checkpoint continuation of its parent (closed form).
+    forked_at = _fork_steps(arms["fork"]["events"])
+    parity_checked = 0
+    for t in arms["fork"]["trials"]:
+        tid = t["id"]
+        if tid not in forked_at or forked_at[tid] is None:
+            continue
+        s_fork = int(forked_at[tid])
+        lr = t["params"]["lr"]
+        budget = t["params"].get("budget", 1)
+        total_steps = max(1, int(round(steps_per_budget * budget)))
+        recorded = dict(zip(t.get("step_history") or [],
+                            t.get("metric_history") or []))
+        if [s for s in recorded if s <= s_fork]:
+            violations.append(
+                "forked trial {} re-trained its parent's prefix: "
+                "recorded steps {} at or below fork point {}".format(
+                    tid, sorted(s for s in recorded if s <= s_fork),
+                    s_fork))
+            continue
+        if not recorded:
+            continue  # all broadcasts raced the FINAL; nothing to check
+        bad = [s for s, v in recorded.items()
+               if v != fork_step_metric(lr, int(s))]
+        if bad:
+            violations.append(
+                "fork parity broken: trial {} steps {} diverge from the "
+                "parent's from-checkpoint continuation".format(
+                    tid, sorted(bad)))
+        else:
+            parity_checked += 1
+        want_final = fork_step_metric(lr, total_steps - 1)
+        if t.get("final_metric") is not None \
+                and t["final_metric"] != want_final:
+            violations.append(
+                "fork final-metric parity broken: trial {} finalized {} "
+                "vs continuation {}".format(tid, t["final_metric"],
+                                            want_final))
+    if not forked_at:
+        violations.append("forking-on arm journaled zero forked_from "
+                          "edges: the hot path never engaged")
+
+    # (c) throughput + identical seeded base schedule across arms (the
+    # promotion TAIL is timing-dependent by design: forking tops the
+    # ladder sooner — rung0_events scopes parity to what must match).
+    schedule_parity = journal_schedule_parity(
+        rung0_events(arms["fork"]["events"]),
+        rung0_events(arms["scratch"]["events"]),
+        label_a="fork_trials", label_b="scratch_trials")
+    if not schedule_parity["match"]:
+        violations.append(
+            "arms executed different rung-0 schedules: symmetric "
+            "difference {}".format(
+                schedule_parity["symmetric_difference"]))
+    wall_ratio = round(arms["scratch"]["wall_s"]
+                       / max(arms["fork"]["wall_s"], 1e-9), 3)
+    if wall_ratio <= 1.0:
+        violations.append(
+            "trials/hour did not improve: forking-on wall {}s vs "
+            "forking-off {}s (ratio {})".format(
+                arms["fork"]["wall_s"], arms["scratch"]["wall_s"],
+                wall_ratio))
+
+    ok = not violations
+    print(json.dumps({
+        "metric": "checkpoint-forking A/B (same ASHA sweep, forking on "
+                  "vs off, journal-replayed)",
+        "value": 1.0 if ok else 0.0,
+        "unit": "fork_gate_ok",
+        "detail": {"fork_ab": {
+            "seed": seed, "trials": trials, "rung_ratio": rf,
+            "wall_s": round(time.time() - t_start, 1),
+            "platform": "cpu (pinned; closed-form trial body — "
+                        "comparable across hosts per the ROADMAP note)",
+            "violations": violations,
+            "top_rung": top_rung,
+            "retrained_steps_fork_on": retrained_fork,
+            "retrained_steps_fork_off": retrained_scratch,
+            "top_rung_trials": n_top_fork,
+            "parity_trials_checked": parity_checked,
+            "schedule_parity": schedule_parity,
+            "trials_per_hour_ratio": wall_ratio,
+            "wall_fork_on_s": arms["fork"]["wall_s"],
+            "wall_fork_off_s": arms["scratch"]["wall_s"],
+            "fork": arms["fork"]["derived"].get("fork"),
+            "fork_off": arms["scratch"]["derived"].get("fork"),
         }},
     }), flush=True)
     return 0 if ok else 1
@@ -1649,6 +1888,8 @@ if __name__ == "__main__":
         sys.exit(chaos_main())
     if "--failover" in sys.argv:
         sys.exit(failover_main())
+    if "--fork" in sys.argv:
+        sys.exit(fork_main())
     if "--fleet" in sys.argv:
         sys.exit(fleet_main())
     if "--pack" in sys.argv:

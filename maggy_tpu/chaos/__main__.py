@@ -14,6 +14,7 @@ the standard soak runs: a runner killed mid-trial, a false preemption,
     python -m maggy_tpu.chaos --agent                    # agent-kill soak
     python -m maggy_tpu.chaos --sink                     # sink-kill soak
     python -m maggy_tpu.chaos --driver                   # driver-kill soak
+    python -m maggy_tpu.chaos --fork                     # fork-kill soak
     python -m maggy_tpu.chaos --show-schedule --seed 7   # no experiment
 
 ``--preempt`` runs the graceful-preemption soak: a mid-trial trial is
@@ -95,6 +96,15 @@ def main(argv=None) -> int:
                          "JAX_PLATFORMS=cpu with "
                          "XLA_FLAGS=--xla_force_host_platform_device_"
                          "count=8")
+    ap.add_argument("--fork", action="store_true",
+                    help="run the checkpoint-forking soak: an ASHA sweep "
+                         "whose promotions fork their rung parents' "
+                         "checkpoints, with the runner holding the first "
+                         "forked trial killed at dispatch — the trial "
+                         "must requeue exactly once and resume from the "
+                         "SAME fork point, genealogy intact; plus one "
+                         "fork across lagom(..., resume=True) driver "
+                         "failover (invariant 14)")
     ap.add_argument("--agent", action="store_true",
                     help="run the remote-agent soak: real agent daemon "
                          "processes (python -m maggy_tpu.fleet agent) "
@@ -140,13 +150,22 @@ def main(argv=None) -> int:
     from maggy_tpu.chaos.plan import FaultPlan
 
     modes = [m for m in ("stall", "piggyback", "preempt", "gang", "agent",
-                         "sink", "driver")
+                         "sink", "driver", "fork")
              if getattr(args, m)]
     if args.plan and modes:
         ap.error("--{} uses a built-in plan; drop --plan".format(modes[0]))
     if len(modes) > 1:
         ap.error("pick one of --stall / --piggyback / --preempt / --gang "
-                 "/ --agent / --sink / --driver")
+                 "/ --agent / --sink / --driver / --fork")
+    if args.fork:
+        # The fork soak owns its whole config (forking ASHA sweep +
+        # checkpointing train fn + the synthetic driver-failover half) —
+        # delegate wholesale.
+        report = harness.run_fork_soak(
+            seed=7 if args.seed is None else args.seed,
+            lock_witness=not args.no_witness)
+        print(json.dumps(report, indent=2, default=str))
+        return 0 if report["ok"] else 1
     if args.driver:
         # The driver soak owns its whole topology (driver + runner-agent
         # SUBPROCESSES; the kill is harness-injected — SIGKILL takes the

@@ -59,6 +59,18 @@ checkable against any soak artifact after the fact):
     double-finalized. A trial that outran detection is the benign
     completed_before_detection outcome. ``gang_plan``, ``python -m
     maggy_tpu.chaos --gang``.
+14. **Checkpoint forks survive runner death** — every injected
+    ``kill_fork`` fault (the runner a FORKED trial — ASHA promotion /
+    PBT exploit resuming a parent's checkpoint — was just dispatched
+    to, killed at the ``forked_from`` edge) is followed by the trial's
+    exactly-once requeue AND a re-dispatch that resumes from the SAME
+    fork point (``resumed`` with ``from_step`` == the forked step —
+    never a silent from-scratch restart), with the genealogy edge
+    journaled exactly once per span. The failover half — one fork
+    across ``lagom(..., resume=True)`` — is checked by
+    ``run_fork_soak`` (``python -m maggy_tpu.chaos --fork``): the
+    replayed journal must rebuild ``forked_from`` from the queued edge.
+
 13. **Driver failover is lossless** — over a MULTI-INCARNATION journal
     (``driver_epoch`` events mark each (re)started driver), every
     ``kill_driver`` fault must be followed by a later incarnation
@@ -104,8 +116,11 @@ from maggy_tpu.chaos.plan import FaultPlan, FaultSpec
 #: slot-reclaim liveness must requeue the trial exactly once — and the
 #: fleet side must revoke the lease (checked from fleet.jsonl by the
 #: soak, not here: this checker sees one experiment's journal).
+#: ``kill_fork`` (invariant 14) kills the runner a FORKED trial was just
+#: dispatched to: same exactly-once-requeue contract, plus the fork-
+#: specific resume checks below.
 _REQUEUE_KINDS = ("kill_runner", "fake_preemption", "preempt_trial",
-                  "kill_gang_member", "kill_agent")
+                  "kill_gang_member", "kill_agent", "kill_fork")
 
 
 def _obs_scrape_loop(stop_evt, stats: Dict[str, Any]) -> None:
@@ -308,6 +323,267 @@ def run_gang_soak(seed: int = 7, num_trials: int = 10, workers: int = 8,
             "or lower hb_loss_timeout)")
         report["ok"] = False
     return report
+
+
+def fork_plan(seed: int = 7, nth: int = 1) -> FaultPlan:
+    """Checkpoint-forking soak (invariant 14): the runner the Nth FORKED
+    trial is dispatched to is killed (``on_phase: forked_from`` — the
+    genealogy edge carries both the trial and the chosen runner). The
+    assignment exists in the reservation table at kill time; the
+    slot-reclaim liveness must requeue the trial EXACTLY once, and the
+    re-dispatch must resume from the SAME fork point — the forked state
+    survives its runner's death."""
+    return FaultPlan([
+        FaultSpec("kill_fork", trigger={"on_phase": "forked_from",
+                                        "nth": nth}),
+    ], seed=seed)
+
+
+def fork_ckpt_train_fn(lr, budget=1, reporter=None, ctx=None):
+    """Forking-soak trial: ASHA budget-scaled, checkpointing every step
+    (TrialCheckpointer's ``checkpoints/<step>/`` layout, written
+    directly — no orbax import), resuming from ``ctx.resume_step`` —
+    which, for a PROMOTED trial, is the FORK POINT the driver staged
+    from the rung parent's checkpoint. The per-step metric is a pure
+    function of (lr, step), so a forked trial's trajectory is
+    step-for-step identical to its parent's continuation — the parity
+    bench.py --fork asserts."""
+    import json as _json
+    import os as _os
+    import time as _time
+
+    steps = max(1, int(round(4 * budget)))
+    start = 0
+    if ctx is not None and ctx.resume_step is not None:
+        state_path = _os.path.join(ctx.trial_dir, "checkpoints",
+                                   str(ctx.resume_step), "state.json")
+        with open(state_path) as f:
+            start = int(_json.load(f)["step"]) + 1
+    metric = None
+    for step in range(start, steps):
+        _time.sleep(0.05)
+        metric = fork_step_metric(lr, step)
+        if ctx is not None:
+            step_dir = _os.path.join(ctx.trial_dir, "checkpoints",
+                                     str(step))
+            _os.makedirs(step_dir, exist_ok=True)
+            with open(_os.path.join(step_dir, "state.json"), "w") as f:
+                _json.dump({"step": step}, f)
+        if reporter is not None:
+            reporter.broadcast(metric, step=step)
+    if metric is None:
+        metric = fork_step_metric(lr, steps - 1)
+    return {"metric": metric}
+
+
+def fork_step_metric(lr, step: int) -> float:
+    """The soak trial's closed-form per-step metric: depends ONLY on
+    (lr, step), so fork parity is decidable offline — a forked child's
+    step-k metric must equal what its parent WOULD have produced at
+    step k."""
+    return 1.0 - (lr - 0.1) ** 2 * (1.0 + 1.0 / (1.0 + step))
+
+
+def run_fork_soak(seed: int = 7, num_trials: int = 4, workers: int = 2,
+                  base_dir: Optional[str] = None,
+                  lock_witness: Optional[bool] = None) -> Dict[str, Any]:
+    """The checkpoint-forking chaos soak (invariant 14), two halves:
+
+    1. **Runner death mid-fork**: an ASHA sweep whose promotions FORK
+       their rung parents' checkpoints runs under ``fork_plan`` — the
+       runner the first forked trial lands on is killed. The trial must
+       requeue exactly once and its re-dispatch must resume from the
+       SAME fork point, genealogy (the once-per-span ``forked_from``
+       edge) intact.
+    2. **Driver failover mid-fork** (the PR-14 follow-up): a
+       synthetically interrupted run whose journal holds an in-flight
+       FORKED promotion is resumed through the real ``lagom(...,
+       resume=True)`` path — the replayed journal must rebuild
+       ``forked_from`` + ``resume_step`` from the queued edge and the
+       fork must complete resuming from the same point.
+
+    Both halves run under the lock-order witness (like every soak)."""
+    from maggy_tpu import Searchspace
+    from maggy_tpu.optimizers import Asha
+
+    plan = fork_plan(seed)
+    report = run_soak(
+        plan=plan, seed=seed, train_fn=fork_ckpt_train_fn,
+        num_trials=num_trials, workers=workers, pool="thread",
+        hb_interval=0.05, hb_loss_timeout=0.6, base_dir=base_dir,
+        lock_witness=lock_witness,
+        config_overrides=dict(
+            optimizer=Asha(reduction_factor=2, resource_min=1,
+                           resource_max=2, seed=seed),
+            searchspace=Searchspace(lr=("DOUBLE", [0.05, 0.2])),
+        ))
+    killed = [r for r in report.get("forks", [])
+              if r.get("outcome") == "resumed_from_fork"]
+    if not [ce for ce in _chaos_of(report, "kill_fork")]:
+        report["violations"].append(
+            "fork fault never fired: the sweep produced no forked_from "
+            "dispatch to kill — the soak exercised nothing")
+        report["ok"] = False
+    elif not killed:
+        # The per-kill violations are already in the report; this is the
+        # exercised-nothing guard's counterpart.
+        report["ok"] = not report["violations"]
+    failover = _run_fork_failover_half(seed)
+    report["fork_failover"] = failover
+    if failover["violations"]:
+        report["violations"].extend(
+            "fork failover: " + v for v in failover["violations"])
+        report["ok"] = False
+    return report
+
+
+def _chaos_of(report: Dict[str, Any], kind: str) -> List[Dict[str, Any]]:
+    return [r for r in report.get("recoveries", [])
+            if r.get("kind") == kind]
+
+
+def _run_fork_failover_half(seed: int) -> Dict[str, Any]:
+    """Half 2 of the fork soak: one fork across ``lagom(...,
+    resume=True)`` driver failover. Builds what a crashed forking driver
+    leaves on disk — two finalized rung-0 trials (artifacts +
+    checkpoints) and one IN-FLIGHT forked promotion whose queued edge
+    carries ``forked_from``/``resume_step`` — then resumes through the
+    real lagom path and checks the journal: the fork completed exactly
+    once, resumed from the same fork point, lineage rebuilt."""
+    import json as _json
+    import os as _os
+    import tempfile as _tempfile
+    import time as _time
+
+    from maggy_tpu import OptimizationConfig, Searchspace, experiment
+    from maggy_tpu.optimizers import Asha
+    from maggy_tpu.telemetry import JOURNAL_NAME, read_events, replay_journal
+    from maggy_tpu.trial import Trial
+
+    base = _tempfile.mkdtemp(prefix="maggy_fork_failover_")
+    app_id = "forkfail"
+    run_dir = _os.path.join(base, "{}_0".format(app_id))
+    p1 = {"lr": 0.1, "budget": 1}
+    p2 = {"lr": 0.18, "budget": 1}
+    t1, t2 = Trial(p1).trial_id, Trial(p2).trial_id
+    child_params = {"lr": 0.1, "budget": 2}
+    child = Trial(child_params).trial_id
+    fork_step = 3  # the parent's last checkpointed step (4 x budget 1)
+    child_info = {"sample_type": "promoted", "rung": 1, "parent": t1,
+                  "forked_from": {"trial": t1, "step": fork_step},
+                  "resume_step": fork_step}
+    t0 = _time.time() - 60
+    events = [
+        {"t": t0, "ev": "driver_epoch", "epoch": 1},
+        {"t": t0, "ev": "experiment", "phase": "start", "name": "forksoak"},
+        {"t": t0 + 0.1, "ev": "runner", "phase": "registered",
+         "partition": 0},
+        {"t": t0 + 0.1, "ev": "runner", "phase": "registered",
+         "partition": 1},
+    ]
+    for tid, params, pid in ((t1, p1, 0), (t2, p2, 1)):
+        events += [
+            {"t": t0 + 0.2, "ev": "trial", "trial": tid,
+             "span": "span-" + tid[:6], "phase": "queued", "params": params,
+             "trial_type": "optimization",
+             "info": {"sample_type": "random", "rung": 0}},
+            {"t": t0 + 0.3, "ev": "trial", "trial": tid,
+             "span": "span-" + tid[:6], "phase": "running",
+             "partition": pid, "epoch": 0},
+            {"t": t0 + 1.0, "ev": "trial", "trial": tid,
+             "span": "span-" + tid[:6], "phase": "finalized",
+             "partition": pid},
+        ]
+    events += [
+        {"t": t0 + 1.2, "ev": "trial", "trial": child,
+         "span": "span-child", "phase": "queued", "params": child_params,
+         "trial_type": "optimization", "info": child_info},
+        {"t": t0 + 1.3, "ev": "trial", "trial": child,
+         "span": "span-child", "phase": "assigned", "partition": 0},
+        {"t": t0 + 1.3, "ev": "trial", "trial": child,
+         "span": "span-child", "phase": "forked_from", "partition": 0,
+         "parent": t1, "step": fork_step},
+        {"t": t0 + 1.4, "ev": "trial", "trial": child,
+         "span": "span-child", "phase": "running", "partition": 0,
+         "epoch": 0},
+    ]
+    _os.makedirs(run_dir, exist_ok=True)
+    with open(_os.path.join(run_dir, JOURNAL_NAME), "w") as f:
+        for ev in events:
+            f.write(_json.dumps(ev) + "\n")
+    for tid, params, metric in ((t1, p1, 0.9), (t2, p2, 0.5)):
+        done = Trial(params, info_dict={"sample_type": "random", "rung": 0})
+        done.status = Trial.FINALIZED
+        done.final_metric = metric
+        _os.makedirs(_os.path.join(run_dir, tid), exist_ok=True)
+        with open(_os.path.join(run_dir, tid, "trial.json"), "w") as f:
+            f.write(done.to_json())
+        for step in range(4):
+            step_dir = _os.path.join(run_dir, tid, "checkpoints",
+                                     str(step))
+            _os.makedirs(step_dir, exist_ok=True)
+            with open(_os.path.join(step_dir, "state.json"), "w") as f:
+                _json.dump({"step": step}, f)
+    for name, payload in (
+            (".run_claim", {}),
+            ("experiment.json", {"name": "forksoak", "state": "RUNNING"}),
+            (".driver_epoch.1", {}),
+            ("driver_state.json", {"secret": "ab" * 16,
+                                   "host": "127.0.0.1", "port": 0,
+                                   "driver_epoch": 1})):
+        with open(_os.path.join(run_dir, name), "w") as f:
+            _json.dump(payload, f)
+
+    old_app = experiment.APP_ID
+    experiment.APP_ID = app_id
+    try:
+        config = OptimizationConfig(
+            name="forksoak", num_trials=2,
+            optimizer=Asha(reduction_factor=2, resource_min=1,
+                           resource_max=2, seed=seed),
+            searchspace=Searchspace(lr=("DOUBLE", [0.05, 0.2])),
+            direction="max", num_workers=2, seed=seed, es_policy="none",
+            experiment_dir=base, resume=True, hb_interval=0.05,
+            hb_loss_timeout=1.0)
+        result = experiment.lagom(fork_ckpt_train_fn, config)
+    finally:
+        experiment.APP_ID = old_app
+    events = read_events(_os.path.join(run_dir, JOURNAL_NAME))
+    violations: List[str] = []
+    report = check_invariants(events)
+    violations.extend(report["violations"])
+    resumed = [ev for ev in events
+               if ev.get("ev") == "trial" and ev.get("trial") == child
+               and ev.get("phase") == "resumed"]
+    if not resumed:
+        violations.append(
+            "recovered fork never resumed: the re-dispatched child "
+            "carries no resumed edge")
+    elif any(ev.get("from_step") != fork_step for ev in resumed):
+        violations.append(
+            "recovered fork lost its fork point: resumed from_step {} "
+            "!= staged step {}".format(
+                [ev.get("from_step") for ev in resumed], fork_step))
+    fork_edges = [ev for ev in events
+                  if ev.get("ev") == "trial" and ev.get("trial") == child
+                  and ev.get("phase") == "forked_from"]
+    if len(fork_edges) != 1:
+        violations.append(
+            "fork lineage not exactly-once across incarnations: {} "
+            "forked_from edges for the child".format(len(fork_edges)))
+    recovered = [ev for ev in events
+                 if ev.get("ev") == "experiment"
+                 and ev.get("phase") == "recovered"]
+    if not recovered or not recovered[0].get("forks"):
+        violations.append(
+            "recovery did not report the rebuilt fork lineage "
+            "(recovered event missing forks count)")
+    derived = replay_journal(_os.path.join(run_dir, JOURNAL_NAME))
+    return {"violations": violations,
+            "result": {"num_trials": result.get("num_trials"),
+                       "best_val": result.get("best_val")},
+            "fork": derived.get("fork") or {},
+            "journal": _os.path.join(run_dir, JOURNAL_NAME)}
 
 
 def ckpt_train_fn(lr, units, reporter=None, ctx=None):
@@ -564,6 +840,7 @@ def check_invariants(events: List[Dict[str, Any]],
     requeued_evs: Dict[str, List[Dict[str, Any]]] = {}
     preempted_evs: Dict[str, List[Dict[str, Any]]] = {}
     resumed_evs: Dict[str, List[Dict[str, Any]]] = {}
+    forked_evs: Dict[str, List[Dict[str, Any]]] = {}
     gang_assembled: Dict[str, List[Dict[str, Any]]] = {}
     gang_released: Dict[str, List[Dict[str, Any]]] = {}
     chaos_events: List[Dict[str, Any]] = []
@@ -627,6 +904,8 @@ def check_invariants(events: List[Dict[str, Any]],
             preempted_evs.setdefault(trial, []).append(dict(ev))
         elif phase == "resumed":
             resumed_evs.setdefault(trial, []).append(dict(ev))
+        elif phase == "forked_from":
+            forked_evs.setdefault(trial, []).append(dict(ev))
         elif phase == "running":
             running_at.setdefault(trial, []).append(t)
         elif phase == "finalized":
@@ -677,7 +956,8 @@ def check_invariants(events: List[Dict[str, Any]],
                     "slow requeue: {} fault on trial {} took {:.2f}s to "
                     "requeue (bound {:.2f}s)".format(
                         ce["kind"], trial, latency, requeue_bound_s))
-        elif finished and ce["kind"] not in ("kill_runner", "kill_agent"):
+        elif finished and ce["kind"] not in ("kill_runner", "kill_agent",
+                                             "kill_fork"):
             # A killed runner/agent can never deliver the FINAL itself —
             # a post-kill FINAL without a requeue would mean a duplicate
             # delivery path, not a benign race.
@@ -817,6 +1097,47 @@ def check_invariants(events: List[Dict[str, Any]],
                 "exists".format(trial))
         gang_recs.append(rec)
 
+    # Invariant 14: checkpoint forks survive runner death. Every
+    # kill_fork fault names the forked trial it disturbed: the requeue
+    # contract (exactly once) is covered by the generic checks above;
+    # on top, the re-dispatch must RESUME from the SAME fork point (a
+    # resumed edge whose from_step equals the forked_from step — never
+    # a silent from-scratch restart) and the genealogy edge must stay
+    # exactly-once per span across the requeue.
+    fork_recs: List[Dict[str, Any]] = []
+    for ce in chaos_events:
+        if ce.get("kind") != "kill_fork":
+            continue
+        trial, t0 = ce.get("trial"), ce.get("t")
+        if trial is None or t0 is None:
+            continue
+        edges = forked_evs.get(trial, [])
+        step = edges[0].get("step") if edges else None
+        rec: Dict[str, Any] = {"trial": trial,
+                               "partition": ce.get("partition"),
+                               "step": step}
+        if len(edges) != 1:
+            violations.append(
+                "fork lineage not exactly-once: trial {} carries {} "
+                "forked_from edges".format(trial, len(edges)))
+        resumes = [r for r in resumed_evs.get(trial, [])
+                   if r.get("t") is not None and r["t"] >= t0]
+        if not resumes:
+            rec["outcome"] = "not_resumed"
+            violations.append(
+                "fork lost: kill_fork hit trial {} but no later resumed "
+                "edge re-dispatched it from its fork point".format(trial))
+        elif step is not None and resumes[0].get("from_step") != step:
+            rec["outcome"] = "wrong_fork_point"
+            violations.append(
+                "fork point drifted: trial {} was forked at step {} but "
+                "resumed from_step={}".format(
+                    trial, step, resumes[0].get("from_step")))
+        else:
+            rec["outcome"] = "resumed_from_fork"
+            rec["from_step"] = resumes[0].get("from_step")
+        fork_recs.append(rec)
+
     # Invariant 5: stall -> health flag. A frozen runner shorter than the
     # loss bound is invisible to the heartbeat-loss scan; the health
     # engine's hang watchdog (or straggler scoring) must still see it,
@@ -944,6 +1265,10 @@ def check_invariants(events: List[Dict[str, Any]],
         "recoveries": recoveries,
         "preemptions": preempt_recs,
         "gang_revocations": gang_recs,
+        # Invariant 14 (checkpoint-forking search): per-kill_fork
+        # outcome — the forked trial's requeue resumed from its exact
+        # fork point with lineage intact.
+        "forks": fork_recs,
         "health": {"engine_ran": health_engine_ran,
                    "raised": len(health_raised),
                    "by_check": health_by_check,

@@ -325,10 +325,14 @@ class ChaosEngine:
             except Exception:  # noqa: BLE001 - journaling must never fail a fault
                 trial = None
         detail: Dict[str, Any] = {}
-        if spec.kind == "kill_runner":
+        if spec.kind in ("kill_runner", "kill_fork"):
             # Real kill when the pool can (process pools); cooperative
             # connection-death otherwise. Condemn EITHER WAY: a SIGKILLed
             # process cannot race it, and on thread pools it is the kill.
+            # kill_fork is the same mechanism with a FORKED victim: the
+            # on_phase=forked_from trigger names the runner the forked
+            # trial was just dispatched to, so invariant 14 can assert
+            # the exactly-once requeue resumes from the same fork point.
             with self._lock:
                 self._condemned.add(pid)
             killed = bool(self.pool is not None

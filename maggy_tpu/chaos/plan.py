@@ -30,6 +30,10 @@ kinds
                          the whole gang lease must be revoked, the
                          members returned to the pool, and the trial
                          requeued exactly once.
+    ``kill_fork``        kill the runner a forked trial was dispatched
+                         to (fire it ``on_phase: forked_from``) — the
+                         trial must be requeued exactly once and resume
+                         from the SAME fork point (invariant 14).
     ``drop_msg``         the server discards a matching request unseen
                          and resets the connection (message lost; the
                          client's retry path re-delivers).
@@ -77,6 +81,7 @@ KINDS = (
     "fake_preemption",
     "preempt_trial",
     "kill_gang_member",
+    "kill_fork",
     "drop_msg",
     "delay_msg",
     "sever_conn",
@@ -94,8 +99,12 @@ KINDS = (
 #: gang trial; the engine resolves the victim through the driver's gang
 #: table) — the whole gang's lease must be revoked and the trial
 #: requeued exactly once (invariant 8).
+#: ``kill_fork`` kills the runner a FORKED trial was just dispatched to
+#: (trigger it ``on_phase: forked_from`` — the genealogy edge names both
+#: the trial and its runner): the trial must be requeued exactly once
+#: and resume from the SAME fork point, lineage intact (invariant 14).
 RUNNER_KINDS = ("kill_runner", "stall_runner", "fake_preemption",
-                "preempt_trial", "kill_gang_member")
+                "preempt_trial", "kill_gang_member", "kill_fork")
 
 _TRIGGER_KEYS = ("after_s", "nth", "every_nth", "probability", "on_phase")
 

@@ -202,6 +202,18 @@ class OptimizationConfig(LagomConfig):
     # reused — and resumed/promoted trials never consume retired buffers.
     # False restores the build-per-trial behavior bit-for-bit.
     warm_start: bool = True
+    # Checkpoint-forking search (docs/user.md "Forking search"): an ASHA
+    # promotion / PBT exploit-or-continue segment / BO near-duplicate is
+    # dispatched with ``forked_from`` + ``resume_step`` stamped into its
+    # assignment, the executor stages the parent's checkpoint into the
+    # child's trial dir (train/checkpoint.fork_checkpoint), and a ctx-
+    # aware train fn RESUMES from that step instead of re-training the
+    # parent's prefix — at the top ASHA rungs this recovers the
+    # rung-ratio multiple of compute. Requires the train fn to
+    # checkpoint via ctx (fns that never checkpoint simply run from
+    # scratch — the stamp resolves to no checkpoint and is skipped).
+    # False restores from-scratch promotions bit-for-bit.
+    fork: bool = True
     # Capture a jax.profiler trace per trial into its TensorBoard dir.
     profile: bool = False
     # Tee the user train_fn's print() calls into the reporter log channel,

@@ -35,6 +35,12 @@ CLIENT_POLL_INTERVAL_S = 1.0
 # event loop's worst-case stall per FINAL.
 PREFETCH_FINAL_LOCK_TIMEOUT_S = 0.05
 REGISTRATION_TIMEOUT_S = 600.0
+# Checkpoint-forking search (config.fork): how long a forked trial may be
+# held for the runner that ran its parent (parent affinity — warm slot +
+# locally staged checkpoint) before ANY idle runner takes it. A few idle
+# ticks: affinity is a preference, never a scheduling stall.
+FORK_AFFINITY_HOLD_S = float(os.environ.get(
+    "MAGGY_TPU_FORK_AFFINITY_HOLD_S", "0.5"))
 # Bound between an elastic RESIZE request and the respawned runner's
 # REGISTER. A respawn that wedges before registering (e.g. a stale device
 # claim at backend init) never heartbeats, so heartbeat-loss detection

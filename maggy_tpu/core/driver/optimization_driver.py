@@ -55,6 +55,10 @@ CONTROLLER_REGISTRY = {
 
 ES_REGISTRY = {"median": MedianStoppingRule, "none": NoStoppingRule}
 
+#: Fork-step cache sentinel: "never looked" is distinct from "looked and
+#: the parent has no checkpoint" (a legitimately cached None).
+_UNRESOLVED = object()
+
 
 class OptimizationDriver(Driver):
     controller_dict = CONTROLLER_REGISTRY
@@ -156,6 +160,30 @@ class OptimizationDriver(Driver):
         # Trials waiting for a gang (FIFO; requeued gang trials wait in
         # _requeue instead and take priority).
         self._gang_wait: List[str] = []  # guarded-by: _store_lock
+        # ---- checkpoint-forking search (config.fork) ----
+        # A suggestion whose info carries a parent (ASHA promotion, PBT
+        # exploit/continue segment, BO near-duplicate) is stamped with
+        # forked_from + resume_step at commit time, so the promoted
+        # trial RESUMES the parent's checkpoint instead of re-training
+        # its prefix (ROADMAP item 3 — the rung-ratio compute win).
+        self._fork_enabled = bool(getattr(config, "fork", True))
+        # Fork affinity: (deadline, preferred partition, trial_id) holds
+        # for forked trials parked briefly for the runner that holds the
+        # parent's warm slot + local checkpoint (extends the PR-14
+        # prewarm lease hints from family-affinity to parent-affinity).
+        self._fork_hold: List[tuple] = []  # guarded-by: _store_lock
+        # Trials that already had their one affinity hold (a second hold
+        # after expiry would starve the trial forever).
+        self._fork_held: set = set()  # guarded-by: _store_lock
+        # Parents whose checkpoint dir was garbage-collected (journaled
+        # ckpt_gc): retirement is once-only and never repeats on disk.
+        self._ckpt_gced: set = set()  # guarded-by: _store_lock
+        # Resolved fork points: parent trial id -> latest ack'd
+        # checkpoint step (or None). A finalized parent's checkpoints
+        # never move, so the env round trip (isdir+ls — two object-store
+        # hops on GCS) is paid once per parent, not once per child, and
+        # repeat exploits of a popular PBT donor stamp lock-free.
+        self._fork_step_cache: Dict[str, Optional[int]] = {}  # guarded-by: _store_lock
         # Assembled gangs: trial_id -> {chips, members, leader, mesh,
         # strategy, revoking}.
         self._gangs: Dict[str, Dict[str, Any]] = {}  # guarded-by: _store_lock
@@ -509,10 +537,21 @@ class OptimizationDriver(Driver):
             self.telemetry.trial_event(trial.trial_id, "requeued",
                                        partition=msg["partition_id"],
                                        reason="blacklist")
+            # A re-registered slot re-running a FORKED (or preempted)
+            # trial resumes like the backlog path would: verify the fork
+            # source survived, journal the resume edge with its step.
+            self._verify_fork_source(trial, msg["partition_id"])
             self.server.reservations.assign_trial(msg["partition_id"], trial.trial_id)
             self.telemetry.trial_event(trial.trial_id, "assigned",
                                        partition=msg["partition_id"],
                                        requeue="blacklist")
+            self._journal_fork_edge(trial, msg["partition_id"])
+            with trial.lock:
+                resume_step = trial.info_dict.get("resume_step")
+            if resume_step is not None:
+                self.telemetry.trial_event(trial.trial_id, "resumed",
+                                           partition=msg["partition_id"],
+                                           from_step=int(resume_step))
             self._log("executor {} restarted; trial {} requeued".format(
                 msg["partition_id"], msg["trial_id"]))
 
@@ -1310,6 +1349,9 @@ class OptimizationDriver(Driver):
         self.env.dump(trial.to_json(),
                       "{}/{}/trial.json".format(self.exp_dir, trial.trial_id))
         self._assign_next(msg["partition_id"], trial)
+        # AFTER the hand-off (the freed runner never waits on disk ops):
+        # retire parent checkpoints this FINAL made unforkable.
+        self._sweep_fork_gc()
 
     def _preempted_final(self, msg, trial: Optional[Trial]) -> None:
         """Requeue a preempted trial (sched lock held). Idempotent under
@@ -1355,7 +1397,15 @@ class OptimizationDriver(Driver):
             if step is not None:
                 trial.info_dict["resume_step"] = int(step)
             else:
-                trial.info_dict.pop("resume_step", None)
+                fork = trial.info_dict.get("forked_from")
+                if fork and fork.get("step") is not None:
+                    # A FORKED trial preempted before it ever
+                    # checkpointed (or even staged) still has its fork
+                    # point: the re-dispatch resumes there, not from
+                    # scratch.
+                    trial.info_dict["resume_step"] = int(fork["step"])
+                else:
+                    trial.info_dict.pop("resume_step", None)
         with self._store_lock:
             if trial.trial_id not in self._requeue:
                 self._requeue.append(trial.trial_id)
@@ -1535,6 +1585,18 @@ class OptimizationDriver(Driver):
                 self._rearm_idle(partition_id)
                 return
             cap = self.server.reservations.capacity(partition_id)
+            held = self._pop_fork_hold(partition_id)
+            if held is not None:
+                # A forked trial held for this runner's warm parent
+                # state (or an expired hold any runner may take).
+                held.set_status(Trial.SCHEDULED)
+                self.server.reservations.assign_trial(partition_id,
+                                                      held.trial_id)
+                self.telemetry.trial_event(held.trial_id, "assigned",
+                                           partition=partition_id,
+                                           fork_affinity=True)
+                self._journal_fork_edge(held, partition_id)
+                return
             parked = self._pop_parked(cap)
             if parked is not None:
                 parked.set_status(Trial.SCHEDULED)
@@ -1545,6 +1607,10 @@ class OptimizationDriver(Driver):
                 return
             requeued = self._pop_requeue(cap)
             if requeued is not None:
+                # A requeued FORK must still have its resume point (the
+                # staged child copy or the parent's original); a vanished
+                # source downgrades it to from-scratch loudly.
+                self._verify_fork_source(requeued, partition_id)
                 self.server.reservations.assign_trial(partition_id, requeued.trial_id)
                 # Neutral label: the backlog holds genuinely lost trials
                 # AND fresh suggestions rerouted off dead partitions — a
@@ -1553,6 +1619,7 @@ class OptimizationDriver(Driver):
                 self.telemetry.trial_event(requeued.trial_id, "assigned",
                                            partition=partition_id,
                                            requeue="backlog")
+                self._journal_fork_edge(requeued, partition_id)
                 with requeued.lock:
                     resume_step = requeued.info_dict.get("resume_step")
                 if resume_step is not None:
@@ -1678,10 +1745,22 @@ class OptimizationDriver(Driver):
                           "{}) asked to resize".format(
                               suggestion.trial_id, need, partition_id, cap))
                 return
+            # Parent affinity: a fresh FORKED suggestion prefers the
+            # runner holding its parent's warm slot + local checkpoint;
+            # this runner pulls the next suggestion instead.
+            if self._maybe_hold_for_parent(suggestion, partition_id):
+                self._log("trial {} held for runner {} (fork parent "
+                          "affinity)".format(
+                              suggestion.trial_id,
+                              self._parent_partition(
+                                  suggestion.info_dict.get(
+                                      "forked_from", {}).get("trial"))))
+                return True  # runner still free: pull the next suggestion
             suggestion.set_status(Trial.SCHEDULED)
             self.server.reservations.assign_trial(partition_id, suggestion.trial_id)
             self.telemetry.trial_event(suggestion.trial_id, "assigned",
                                        partition=partition_id)
+            self._journal_fork_edge(suggestion, partition_id)
 
     def _mint_span(self, trial: Trial) -> None:
         """Mint the trial's telemetry span when the driver commits to it
@@ -1694,9 +1773,13 @@ class OptimizationDriver(Driver):
         so recovery can verify the round trip). The scheduler half of
         info_dict rides along too — an ASHA promotion's rung/parent or a
         PBT segment's member/generation must survive the crash, or the
-        re-run's FINAL would bookkeep into the wrong ledger slot;
-        dispatch-time keys (span/gang/partition/epoch) are rebuilt by
-        recovery itself and stay out."""
+        re-run's FINAL would bookkeep into the wrong ledger slot — and
+        the fork stamp below is applied FIRST so forked_from/resume_step
+        land on the queued edge and a driver crash cannot orphan a fork
+        mid-flight (recovery rebuilds the lineage from exactly this
+        event); dispatch-time keys (span/gang/partition/epoch) are
+        rebuilt by recovery itself and stay out."""
+        self._stamp_fork(trial)
         with trial.lock:
             sched_info = {k: v for k, v in trial.info_dict.items()
                           if k not in ("span", "gang", "partition", "epoch")}
@@ -1707,6 +1790,222 @@ class OptimizationDriver(Driver):
         if span is not None:
             with trial.lock:
                 trial.info_dict["span"] = span
+
+    # -------------------------------------------- checkpoint-forking search
+
+    def _stamp_fork(self, trial: Trial) -> None:
+        """Turn a parent-carrying suggestion into a checkpoint FORK: if
+        the parent left an ack'd checkpoint, stamp ``forked_from`` =
+        (parent, step) + ``resume_step`` into the trial's info so the
+        TRIAL payload ships them, the executor stages the parent's
+        checkpoint into the child's dir, and a ctx-aware train fn
+        resumes at ``step + 1`` instead of re-training the prefix. A
+        parent with no checkpoint (ctx-less train fn, GC'd dir) leaves
+        the trial untouched — from-scratch promotion, the pre-fork
+        behavior. config.fork=False disables the stamp wholesale
+        (bit-for-bit from-scratch promotions)."""
+        if not self._fork_enabled:
+            return
+        with trial.lock:
+            parent = trial.info_dict.get("parent")
+            already = trial.info_dict.get("forked_from")
+        if parent is None or already is not None:
+            return
+        with self._store_lock:
+            cached = self._fork_step_cache.get(parent, _UNRESOLVED)
+        if cached is not _UNRESOLVED:
+            step = cached
+        else:
+            from maggy_tpu.train.checkpoint import \
+                latest_checkpoint_step_env
+
+            try:
+                step = latest_checkpoint_step_env(
+                    self.env, "{}/{}".format(self.exp_dir, parent))
+            except Exception:  # noqa: BLE001 - an unreadable dir = no fork
+                step = None
+            with self._store_lock:
+                self._fork_step_cache[parent] = step
+        if step is None:
+            return
+        with trial.lock:
+            trial.info_dict["forked_from"] = {"trial": parent,
+                                              "step": int(step)}
+            trial.info_dict["resume_step"] = int(step)
+
+    def _journal_fork_edge(self, trial: Trial, partition_id: int) -> None:
+        """The genealogy span edge (once per span — a requeued fork's
+        re-dispatch does not repeat it): parent -> child with the forked
+        step, rendered by trace.py as a Perfetto flow arrow and counted
+        by derive()'s fork block."""
+        with trial.lock:
+            fork = trial.info_dict.get("forked_from")
+        if not fork:
+            return
+        self.telemetry.trial_event(trial.trial_id, "forked_from",
+                                   once=True, partition=partition_id,
+                                   parent=fork.get("trial"),
+                                   step=fork.get("step"))
+
+    def _verify_fork_source(self, trial: Trial, partition_id: int) -> None:
+        """Before re-dispatching a requeued FORKED trial: its resume
+        point must still exist — either the child's staged checkpoint
+        (the first attempt got far enough to stage) or the parent's
+        original (GC keeps it while a fork is schedulable, but disk loss
+        or an operator wipe can race). A vanished source downgrades the
+        trial to from-scratch LOUDLY (requeued reason=fork_source_lost +
+        stripped fork keys) instead of letting the runner crash opening
+        a checkpoint that is not there."""
+        with trial.lock:
+            fork = trial.info_dict.get("forked_from")
+        if not fork:
+            return
+        step = fork.get("step")
+        child = "{}/{}/checkpoints/{}".format(self.exp_dir, trial.trial_id,
+                                              step)
+        parent = "{}/{}/checkpoints/{}".format(self.exp_dir,
+                                               fork.get("trial"), step)
+        try:
+            ok = self.env.isdir(child) or self.env.isdir(parent)
+        except Exception:  # noqa: BLE001 - unreadable = assume gone
+            ok = False
+        if ok:
+            return
+        with trial.lock:
+            trial.info_dict.pop("forked_from", None)
+            trial.info_dict.pop("resume_step", None)
+        self.telemetry.trial_event(trial.trial_id, "requeued",
+                                   partition=partition_id,
+                                   reason="fork_source_lost",
+                                   parent=fork.get("trial"), step=step)
+        self._log("fork source for trial {} (parent {} step {}) vanished; "
+                  "re-running from scratch".format(
+                      trial.trial_id, fork.get("trial"), step))
+
+    def _parent_partition(self, parent_id: str) -> Optional[int]:
+        """The partition that last ran (and checkpointed) the parent —
+        where its warm slot and locally-staged checkpoint live."""
+        return self.telemetry.spans.partition_of(parent_id)
+
+    # locked-by: _sched_lock
+    def _maybe_hold_for_parent(self, trial: Trial,
+                               partition_id: int) -> bool:
+        """Parent-affinity (the PR-14 prewarm hints extended from family
+        to parent scope): a forked trial dispatched while the parent's
+        runner is alive is briefly HELD for that runner — it already
+        holds the family's warm slot AND the parent's checkpoint on
+        local disk, so the fork loads without a cross-runner copy. Held
+        at most once per trial and at most FORK_AFFINITY_HOLD_S (then
+        any runner takes it), so affinity can never starve the trial.
+        Returns True when held — the asking runner pulls its next
+        suggestion."""
+        if not self._fork_enabled or self._chips_map is not None:
+            # Elastic pools size runners per budget: an affinity hold
+            # would bypass the capacity matching below.
+            return False
+        with trial.lock:
+            fork = trial.info_dict.get("forked_from")
+        if not fork:
+            return False
+        preferred = self._parent_partition(fork.get("trial"))
+        if preferred is None or int(preferred) == int(partition_id):
+            return False
+        with self._store_lock:
+            if trial.trial_id in self._fork_held:
+                return False
+        if self._partition_state(int(preferred)) != "live":
+            return False
+        with self._store_lock:
+            self._fork_held.add(trial.trial_id)
+            self._fork_hold.append(
+                (time.monotonic() + constants.FORK_AFFINITY_HOLD_S,
+                 int(preferred), trial.trial_id))
+        return True
+
+    def _pop_fork_hold(self, partition_id: int) -> Optional[Trial]:
+        """A trial held for THIS partition (parent affinity), or any
+        EXPIRED hold — whoever idles first past the deadline takes it."""
+        now = time.monotonic()
+        with self._store_lock:
+            for i, (deadline, preferred, tid) in enumerate(self._fork_hold):
+                if preferred != int(partition_id) and now < deadline:
+                    continue
+                del self._fork_hold[i]
+                trial = self._trial_store.get(tid)
+                if trial is not None:
+                    return trial
+        return None
+
+    # locked-by: _sched_lock
+    def _sweep_fork_gc(self) -> None:
+        """Checkpoint GC: retire a parent's checkpoint dir once the
+        controller reports no live or schedulable child can still fork
+        from it (Asha: the promotion child finalized; PBT: the segment
+        was superseded as its member's population state). Never touches
+        a LIVE trial — anything still in the store/backlogs keeps its
+        latest ack'd step — and each retirement journals a ``ckpt_gc``
+        event, so a forking sweep's disk stays bounded and auditable.
+        Only the ELIGIBILITY decision runs here (cheap dict ops, sched
+        lock held); the recursive dir deletions happen on a short-lived
+        daemon thread — on the prefetch inline FINAL path this method
+        runs on the RPC event loop before the reply is written, and
+        tree deletions there would stall every tenant heartbeat."""
+        if not self._fork_enabled:
+            return
+        eligible = getattr(self.controller, "fork_gc_eligible", None)
+        if eligible is None:
+            return
+        try:
+            candidates = list(eligible())
+        except Exception:  # noqa: BLE001 - GC is an optimization, never fatal
+            return
+        todo = []
+        with self._store_lock:
+            for tid in candidates:
+                if tid in self._ckpt_gced:
+                    continue
+                if (tid in self._trial_store or tid in self._requeue
+                        or tid in self._parked):
+                    continue
+                # Claimed now so a racing next sweep cannot double-GC;
+                # a failed delete un-claims for retry.
+                self._ckpt_gced.add(tid)
+                todo.append(tid)
+        if todo:
+            threading.Thread(target=self._fork_gc_worker, args=(todo,),
+                             daemon=True, name="fork-gc").start()
+
+    def _fork_gc_worker(self, todo: List[str]) -> None:
+        """Off-hot-path half of checkpoint GC: the env I/O. Runs without
+        any driver lock — a GC'd trial is finalized and non-live by the
+        sweep's claim above, so nothing races the deletion (and even a
+        pathological race only costs a fork its source, which the
+        fork_source_lost downgrade absorbs loudly)."""
+        for tid in todo:
+            path = "{}/{}/checkpoints".format(self.exp_dir, tid)
+            try:
+                had = self.env.isdir(path)
+                if had:
+                    self.env.delete(path, recursive=True)
+            except Exception:  # noqa: BLE001 - a failed delete retries next sweep
+                with self._store_lock:
+                    self._ckpt_gced.discard(tid)
+                continue
+            if had:
+                with self._store_lock:
+                    # A later stamp against this parent (a BO
+                    # near-duplicate may pick ANY finalized trial) must
+                    # see "no checkpoint", not the stale pre-GC step.
+                    self._fork_step_cache[tid] = None
+                try:
+                    self.telemetry.event("ckpt_gc", trial=tid,
+                                         why="no_schedulable_child")
+                    self._log("ckpt_gc: retired checkpoints of "
+                              "{}".format(tid))
+                except Exception:  # noqa: BLE001 - the final sweep's worker may
+                    # outlive experiment teardown (journal closed); the
+                    # deletion itself already happened.
+                    pass
 
     # -------------------------------------------------------------- results
 

@@ -150,6 +150,7 @@ def recover_optimization_driver(driver) -> Optional[Dict[str, Any]]:
         already_final = {t.trial_id for t in driver._final_store}
     restored_inflight = 0
     requeued = 0
+    restored_forks = 0
     held: Dict[int, str] = {}
     for facts in state.inflight():
         if facts.trial_id in already_final:
@@ -180,6 +181,12 @@ def recover_optimization_driver(driver) -> Optional[Dict[str, Any]]:
         with driver._store_lock:
             driver._trial_store[trial.trial_id] = trial
         restored_inflight += 1
+        if facts.info.get("forked_from") is not None:
+            # The fork lineage rode the queued edge (forked_from +
+            # resume_step in the journaled info), so a driver crash
+            # cannot orphan a fork mid-flight: the reconstructed trial
+            # re-dispatches resuming from the SAME fork point.
+            restored_forks += 1
         if facts.partition is not None:
             # The pre-crash holder: restore the assignment so a live
             # runner's retried FINAL matches, and a dead one's silence
@@ -211,4 +218,5 @@ def recover_optimization_driver(driver) -> Optional[Dict[str, Any]]:
         "held_partitions": len(held),
         "backlogged": requeued,
         "recovered_partitions": len(recovered_pids),
+        "forks": restored_forks,
     }

@@ -27,13 +27,18 @@ from typing import Any, Dict, Optional
 
 def info_needs_fresh_state(info: Dict[str, Any]) -> bool:
     """Does a trial's assignment ``info`` dict mark it as CONTINUING
-    saved state (preemption resume / promoted parent)? The single home
-    of this rule: ``TrialContext.needs_fresh_state`` and the executor's
-    warm trial scope both consult it — widening it in one place but not
-    the other would silently re-enable retired-buffer donation for
-    exactly the trials that must restore a checkpoint instead."""
+    saved state (preemption resume / promoted parent / checkpoint
+    fork)? The single home of this rule: ``TrialContext.
+    needs_fresh_state`` and the executor's warm trial scope both
+    consult it — widening it in one place but not the other would
+    silently re-enable retired-buffer donation for exactly the trials
+    that must restore a checkpoint instead. The fork case keeps the
+    COMPILED step (the warm slot's executables are program identity,
+    not values) while dropping the retired buffers the staged
+    checkpoint replaces."""
     return (info.get("resume_step") is not None
-            or info.get("parent") is not None)
+            or info.get("parent") is not None
+            or info.get("forked_from") is not None)
 
 
 class TrialContext:
@@ -63,6 +68,35 @@ class TrialContext:
     def parent_trial_id(self) -> Optional[str]:
         """For a promoted ASHA/Hyperband trial: the trial it continues."""
         return self.info.get("parent")
+
+    @property
+    def forked_from(self) -> Optional[Dict[str, Any]]:
+        """Checkpoint-fork lineage stamped by the driver (config.fork):
+        ``{"trial": <parent id>, "step": <checkpoint step>}`` when this
+        trial was dispatched to resume from another trial's checkpoint
+        (ASHA promotion, PBT exploit/continue, BO near-duplicate). The
+        executor stages the parent's checkpoint into THIS trial's dir
+        before the train fn runs, so ``restore_checkpoint`` +
+        ``resume_step`` work exactly like a same-trial preemption
+        resume. None = from-scratch run."""
+        fork = self.info.get("forked_from")
+        return dict(fork) if fork else None
+
+    def stage_fork(self) -> Optional[int]:
+        """Stage the forked-from parent's checkpoint into this trial's
+        dir (idempotent; see train/checkpoint.fork_checkpoint). Returns
+        the staged step, or None when there is nothing to fork. The
+        executor calls this before the train fn; it is exposed on the
+        ctx so library code can re-stage explicitly."""
+        fork = self.info.get("forked_from")
+        if not fork or not fork.get("trial"):
+            return None
+        from maggy_tpu.core.environment import EnvSing
+        from maggy_tpu.train.checkpoint import fork_checkpoint
+
+        return fork_checkpoint(EnvSing.get_instance(), self.exp_dir,
+                               fork["trial"], self.trial_dir,
+                               step=fork.get("step"))
 
     @property
     def resume_step(self) -> Optional[int]:

@@ -214,6 +214,19 @@ class TrialExecutor:
                         ctx = TrialContext(trial_id, trial_dir, exp_dir,
                                            params, client.last_info)
                         call_params["ctx"] = ctx
+                    if (client.last_info or {}).get("forked_from"):
+                        # Checkpoint fork (config.fork): stage the
+                        # parent's checkpoint into THIS trial's dir so
+                        # ctx.restore_checkpoint/resume_step behave
+                        # exactly like a same-trial preemption resume.
+                        # The load is timed into the trial's compile
+                        # record (fork_load_ms) — the warm path keeps
+                        # the compiled step while values come from the
+                        # staged checkpoint, and the journal must show
+                        # what the load cost.
+                        self._stage_fork(ctx, trial_id, trial_dir,
+                                         exp_dir, params, client,
+                                         reporter, stats)
                     # Warm-slot lifecycle around the trial fn: inside the
                     # scope, Trainers default to the warm path
                     # (config.warm_start), compile telemetry lands in this
@@ -290,6 +303,50 @@ class TrialExecutor:
                 pass
             client.stop()
 
+
+    def _stage_fork(self, ctx, trial_id: str, trial_dir: str,
+                    exp_dir: str, params: dict, client, reporter,
+                    stats) -> None:
+        """Stage a forked trial's parent checkpoint into its trial dir
+        (idempotent — a requeued fork re-stages to the SAME step). A
+        staging failure (parent checkpoint vanished mid-flight, torn
+        copy) downgrades the trial to a from-scratch run: the fork keys
+        are stripped from the assignment info so ``ctx.resume_step``
+        reads None and the train fn's resume branch never opens a
+        checkpoint that is not there."""
+        import time as _time
+
+        fork = dict((client.last_info or {}).get("forked_from") or {})
+        t0 = _time.monotonic()
+        staged = None
+        try:
+            if ctx is not None:
+                staged = ctx.stage_fork()
+            else:
+                from maggy_tpu.core.environment import EnvSing
+                from maggy_tpu.train.checkpoint import fork_checkpoint
+
+                staged = fork_checkpoint(
+                    EnvSing.get_instance(), exp_dir, fork.get("trial"),
+                    trial_dir, step=fork.get("step"))
+        except Exception:  # noqa: BLE001 - a broken fork must not kill the trial
+            staged = None
+        if staged is None:
+            reporter.log(
+                "Trial {}: fork source {} step {} unavailable; running "
+                "from scratch.".format(trial_id, fork.get("trial"),
+                                       fork.get("step")))
+            for key in ("forked_from", "resume_step"):
+                client.last_info.pop(key, None)
+                if ctx is not None:
+                    ctx.info.pop(key, None)
+            return
+        stats.note_compile(fork_load_ms=(_time.monotonic() - t0) * 1e3,
+                           forked=True)
+        reporter.log("Trial {} forked from {} at checkpoint step {} "
+                     "({}ms load).".format(
+                         trial_id, fork.get("trial"), staged,
+                         round((_time.monotonic() - t0) * 1e3, 1)))
 
     def _run_gang_member(self, trial_id: str, params: dict, client,
                          reporter) -> None:

@@ -278,6 +278,13 @@ class FleetScheduler:
         # binding pick prefers handing an agent a same-family experiment
         # so its per-process warm slots (train/warm.py) stay hot.
         self._slot_family: Dict[int, str] = {}  # guarded-by: _lock
+        # Parent affinity (checkpoint-forking search): agent slot -> the
+        # EXPERIMENT it last served. A re-lease to the same experiment
+        # is strictly warmer than same-family: the agent holds that
+        # experiment's warm slots AND its parents' trial checkpoints on
+        # local disk, so a forked promotion staged there loads without a
+        # cross-process copy. Ranked above family, below fair share.
+        self._slot_exp: Dict[int, str] = {}  # guarded-by: _lock
         # Remote-agent runner slots (maggy_tpu.fleet.agent): indexes at
         # and above the thread-fleet size, allocated as agents join.
         # Vacant slots (their agent left/died) stay allocated — indexes
@@ -489,6 +496,7 @@ class FleetScheduler:
             if runner_idx in self._agent_slots:
                 self._vacant_agent_slots.add(runner_idx)
                 self._slot_family.pop(runner_idx, None)
+                self._slot_exp.pop(runner_idx, None)
                 self._targets_cache = None
                 self._wake.notify_all()
 
@@ -710,6 +718,7 @@ class FleetScheduler:
         now = time.monotonic()
         slot_family = self._slot_family.get(runner_idx) if is_agent \
             else None
+        slot_exp = self._slot_exp.get(runner_idx) if is_agent else None
         best = None
         best_key = None
         for e in self._active.values():
@@ -722,13 +731,20 @@ class FleetScheduler:
             if e.allocated() >= e.effective_max(self.fleet_size):
                 continue
             # Warm prewarming hint: among equally-deserving (same
-            # deficit, same class) candidates, prefer the experiment
-            # whose program family this agent ALREADY holds warm slots
-            # for — a same-family re-lease skips the trace+compile cost
-            # entirely (train/warm.py). Ranked below deficit and class
-            # so warmth can never override fair share or priority.
-            cold = 0 if (slot_family is not None
-                         and e.train_fn_path == slot_family) else 1
+            # deficit, same class) candidates, prefer (0) the SAME
+            # experiment this agent last served — parent affinity: its
+            # warm slots AND its trials' checkpoints (fork sources) live
+            # in that agent's process/disk — then (1) the same program
+            # family (compiled step reuse, train/warm.py), then (2)
+            # cold. Ranked below deficit and class so warmth can never
+            # override fair share or priority.
+            if slot_exp is not None and e.name == slot_exp:
+                cold = 0
+            elif slot_family is not None \
+                    and e.train_fn_path == slot_family:
+                cold = 1
+            else:
+                cold = 2
             key = (e.allocated() - targets.get(e.name, 0),
                    e.policy.rank, cold, e.vtime(now), e.seq)
             if best_key is None or key < best_key:
@@ -748,16 +764,24 @@ class FleetScheduler:
         # Warm prewarming hint bookkeeping (agent slots only: warm slots
         # are per-process, and only agents persist across leases):
         # warm_hint=True means this lease lands on an agent that already
-        # holds the experiment's program family warm.
+        # holds the experiment's program family warm; warm_affinity
+        # grades it — "experiment" (parent affinity: same experiment,
+        # checkpoints on local disk) beats "family" (compiled step only).
         warm_hint = None
+        warm_affinity = None
         if runner_idx in self._agent_slots \
                 and entry.train_fn_path is not None:
             warm_hint = self._slot_family.get(runner_idx) \
                 == entry.train_fn_path
+            if self._slot_exp.get(runner_idx) == entry.name:
+                warm_affinity = "experiment"
+            elif warm_hint:
+                warm_affinity = "family"
             self._slot_family[runner_idx] = entry.train_fn_path
+            self._slot_exp[runner_idx] = entry.name
         self._event("lease", exp=entry.name, runner=runner_idx, pid=pid,
                     phase="start", exp_dir=entry.exp_dir,
-                    warm_hint=warm_hint)
+                    warm_hint=warm_hint, warm_affinity=warm_affinity)
         return entry, pid
 
     def release_binding(self, runner_idx: int, entry: ExperimentEntry,
@@ -1461,8 +1485,12 @@ def replay_fleet_journal(path: str, env=None,
     # Warm prewarming hints: how many agent-slot leases landed on an
     # agent already holding the experiment's program family warm
     # (lease-event warm_hint field; None = thread runner / family-less).
+    # warm_affinity grades the hits: "experiment" = parent affinity
+    # (same experiment re-lease — fork checkpoints on local disk),
+    # "family" = compiled-step reuse only.
     warm_hint_hits = 0
     warm_hint_misses = 0
+    warm_affinity_exp = 0
     # Journal-sink ingest records (jsink) + per-agent clock offsets —
     # the telemetry fan-in plane's replayable numbers.
     sink_batches = 0
@@ -1512,6 +1540,8 @@ def replay_fleet_journal(path: str, env=None,
                     warm_hint_hits += 1
                 elif ev.get("warm_hint") is False:
                     warm_hint_misses += 1
+                if ev.get("warm_affinity") == "experiment":
+                    warm_affinity_exp += 1
             elif ev.get("phase") == "end":
                 t0 = e["open"].pop(key, None)
                 if t0 is not None and t is not None:
@@ -1619,9 +1649,12 @@ def replay_fleet_journal(path: str, env=None,
             "per_agent_leases": dict(sorted(agent_leases.items())),
             "abind_ms": _dist_stats(abind_ms),
             # Prewarming-hint accuracy: agent leases that landed on an
-            # already-warm family vs cold re-binds.
+            # already-warm family vs cold re-binds; warm_affinity_exp =
+            # the subset that re-leased the SAME experiment (parent
+            # affinity: fork checkpoints on the agent's local disk).
             "warm_hint_hits": warm_hint_hits,
             "warm_hint_misses": warm_hint_misses,
+            "warm_affinity_exp": warm_affinity_exp,
         },
         # Journal-sink ingest (empty/zero when no tenant/agent shipped).
         "sink": {

@@ -162,6 +162,19 @@ def render_telem(snap: Dict[str, Any]) -> str:
                 "  xla persistent cache: {} hits / {} misses (hit rate "
                 "{})".format(cache.get("hits", 0), cache.get("misses", 0),
                              cache.get("hit_rate")))
+    fork = spans.get("fork") or {}
+    if fork:
+        # Checkpoint-forking search: promotions/exploits that RESUMED a
+        # parent's checkpoint vs re-trained from scratch, and what each
+        # fork saved / cost.
+        lines.append(
+            "forking: {} forked / {} from-scratch, {} steps saved, "
+            "load {}{}".format(
+                fork.get("forked", 0), fork.get("from_scratch", 0),
+                fork.get("steps_saved", 0),
+                _fmt_dist(fork.get("fork_load_ms") or {}),
+                ", {} ckpt GC'd".format(fork["ckpt_gc"])
+                if fork.get("ckpt_gc") else ""))
     hists = (snap.get("metrics") or {}).get("histograms") or {}
     rpc = sorted(((name, h) for name, h in hists.items()
                   if name.startswith("rpc.handle_ms.")),

@@ -125,6 +125,18 @@ class AbstractOptimizer(ABC):
         return type(self).get_suggestion is AbstractOptimizer.get_suggestion \
             and type(self).suggest is not AbstractOptimizer.suggest
 
+    def fork_gc_eligible(self) -> List[str]:
+        """Checkpoint-GC eligibility (checkpoint-forking search,
+        config.fork): trial ids whose on-disk checkpoints NO live or
+        schedulable child can still fork from — the driver retires
+        their ``checkpoints/`` dir and journals ``ckpt_gc``, bounding a
+        forking sweep's disk. Must be CONSERVATIVE: a parent that could
+        still be promoted/exploited/continued from must never appear
+        (the driver additionally refuses to touch live trials). Default:
+        nothing is ever eligible (controllers that fork must say which
+        parents are spent)."""
+        return []
+
     def finalize_experiment(self, trials: List[Trial]) -> None:
         """Called once after the experiment completes."""
 

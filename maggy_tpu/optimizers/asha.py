@@ -154,6 +154,35 @@ class Asha(AbstractOptimizer):
             if parent in promoted:
                 promoted.remove(parent)
 
+    def fork_gc_eligible(self):
+        """Checkpoint GC (checkpoint-forking search): a rung parent's
+        checkpoint is spent once its PROMOTION CHILD has finalized
+        successfully — the child resumed (or chose not to), nothing can
+        fork from the parent again (a trial is promoted out of a rung at
+        most once, and _promotable never re-picks a promoted id). A
+        not-yet-promoted trial stays: promotion eligibility GROWS as
+        rungs fill (top-k widens with every FINAL). Once the experiment
+        is exhausted every finalized trial's checkpoint is spent —
+        EXCEPT the top-rung survivors': the sweep's whole point is the
+        winner's trained state, and GC'ing it at the finish line would
+        delete the model the user came for."""
+        metrics = self.get_metrics_dict()
+        if self._exhausted:
+            keep = set(self.rungs.get(self.max_rung, []))
+            return sorted(tid for tid in metrics if tid not in keep)
+        eligible = []
+        finalized_children: Dict[str, int] = {}
+        for t in self.final_store:
+            parent = t.info_dict.get("parent")
+            if parent is not None and t.final_metric is not None:
+                finalized_children[parent] = \
+                    finalized_children.get(parent, 0) + 1
+        for rung, parents in self.promoted.items():
+            for parent in parents:
+                if finalized_children.get(parent):
+                    eligible.append(parent)
+        return eligible
+
     def restore(self, finalized) -> None:
         """Rebuild the rung ladder from a previous run: each finalized trial
         re-enters its rung, and a promoted child marks its parent as already

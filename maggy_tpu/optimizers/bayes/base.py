@@ -36,6 +36,7 @@ class BaseAsyncBO(AbstractOptimizer):
         random_fraction: float = 0.33,
         interim_results: bool = False,
         interim_results_interval: int = 10,
+        fork_eps: Optional[float] = None,
         seed=None,
         pruner=None,
         pruner_kwargs=None,
@@ -45,6 +46,16 @@ class BaseAsyncBO(AbstractOptimizer):
         self.random_fraction = random_fraction
         self.interim_results = interim_results
         self.interim_results_interval = interim_results_interval
+        #: Checkpoint-forking near-duplicate warm start (config.fork):
+        #: when a MODEL-proposed config lands within ``fork_eps`` (L2 in
+        #: the searchspace's normalized transform) of an already
+        #: finalized config, the suggestion carries that trial as its
+        #: ``parent`` — the driver forks the neighbor's checkpoint and a
+        #: ctx-aware train fn fine-tunes from it instead of re-training
+        #: from scratch. None (default) = off: BO proposals are
+        #: exploratory by design, so opting in is an explicit judgment
+        #: that the space is smooth enough for neighbor warm starts.
+        self.fork_eps = fork_eps
         self.warmup_buffer: List[dict] = []
         #: budget -> fitted surrogate (0 = single fidelity), set by update_model
         self.models: Dict[float, object] = {}
@@ -146,7 +157,33 @@ class BaseAsyncBO(AbstractOptimizer):
         if self.hparams_exist(trial):
             self._forced_random_failures += 1
             return None
+        # Near-duplicate warm start (fork_eps): a model proposal next to
+        # an executed config inherits its checkpoint as a fork parent.
+        # Model proposals only — warmup/random samples are exploration
+        # and must stay unbiased by a neighbor's training trajectory.
+        if self.fork_eps is not None \
+                and trial.info_dict.get("sample_type") == "model":
+            donor = self._near_duplicate(trial)
+            if donor is not None:
+                trial.info_dict["parent"] = donor
+                trial.info_dict["near_duplicate"] = True
         return trial
+
+    def _near_duplicate(self, trial: Trial) -> Optional[str]:
+        """The nearest finalized trial within ``fork_eps`` (L2 over the
+        searchspace's normalized transform), or None."""
+        finalized = self._finalized()
+        if not finalized:
+            return None
+        X = self.searchspace.transform_batch(
+            [self._strip_budget(t.params) for t in finalized])
+        x = self.searchspace.transform_batch(
+            [self._strip_budget(trial.params)])[0]
+        d = np.linalg.norm(np.asarray(X) - np.asarray(x), axis=1)
+        i = int(np.argmin(d))
+        if float(d[i]) <= float(self.fork_eps):
+            return finalized[i].trial_id
+        return None
 
     def _model_budget(self, run_budget: float) -> float:
         """Which surrogate to use for a given run budget: largest budget with

@@ -242,6 +242,26 @@ class PBT(AbstractOptimizer):
                     out[name] = value
         return out
 
+    def fork_gc_eligible(self):
+        """Checkpoint GC (checkpoint-forking search): a segment's
+        checkpoint is spent once it is SUPERSEDED — it is no longer any
+        member's latest finalized segment (exploit donors and continue
+        parents are always drawn from ``_population_state``), and no
+        pending or in-flight segment still names it as parent (a queued
+        exploit must be able to stage its donor's checkpoint when it
+        finally dispatches)."""
+        keep = {t.trial_id for t in self._population_state().values()}
+        for pending in self._pending:
+            parent = pending.info_dict.get("parent")
+            if parent is not None:
+                keep.add(parent)
+        for t in self.trial_store.values():
+            parent = t.info_dict.get("parent")
+            if parent is not None:
+                keep.add(parent)
+        return [t.trial_id for t in self.final_store
+                if t.final_metric is not None and t.trial_id not in keep]
+
     # ---------------------------------------------------------------- resume
 
     def restore(self, finalized) -> None:

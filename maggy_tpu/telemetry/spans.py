@@ -97,6 +97,13 @@ class SpanTracker:
             span = self._spans.get(trial_id)
             return span.span_id if span else None
 
+    def partition_of(self, trial_id: str) -> Optional[int]:
+        """The trial's LAST observed partition (the fork-affinity hint:
+        where the parent's warm slot and checkpoint live), or None."""
+        with self._lock:
+            span = self._spans.get(trial_id)
+            return span.partition if span else None
+
     def mark(self, trial_id: str, phase: str, t: Optional[float] = None,
              partition: Optional[int] = None) -> tuple:
         """Record ``phase`` on the trial's span (minting it if the caller
@@ -186,6 +193,11 @@ def derive(events: List[Dict[str, Any]],
       cumulative hit/miss counts summed over runners (from the
       ``runner_stats`` events' counter fields). Empty for pre-warm
       journals.
+    - ``fork``: checkpoint-forking genealogy — forked vs from-scratch
+      promotion counts, the parent steps the forks did NOT re-train
+      (``steps_saved``), fork-load (checkpoint staging) latency p50/p95,
+      downgrades (``fork_source_lost``) and ``ckpt_gc`` retirements.
+      Empty for non-forking journals.
     - ``trials``: lifecycle counts.
     """
     by_partition: Dict[int, List[tuple]] = {}
@@ -207,6 +219,10 @@ def derive(events: List[Dict[str, Any]],
     compiled_recs: Dict[str, Dict[str, Any]] = {}
     cache_cum: Dict[Any, Dict[str, int]] = {}
     cache_banked: Dict[Any, Dict[str, int]] = {}
+    forked: Dict[str, Dict[str, Any]] = {}
+    parented: set = set()
+    fork_downgrades = 0
+    ckpt_gcs = 0
     for ev in events:
         if ev.get("ev") == "suggest":
             if ev.get("ms") is not None:
@@ -228,6 +244,9 @@ def derive(events: List[Dict[str, Any]],
                         bank[key] = bank.get(key, 0) + cum[key]
                     cum[key] = v
             continue
+        if ev.get("ev") == "ckpt_gc":
+            ckpt_gcs += 1
+            continue
         if ev.get("ev") != "trial":
             continue
         phase, t, trial = ev.get("phase"), ev.get("t"), ev.get("trial")
@@ -235,6 +254,11 @@ def derive(events: List[Dict[str, Any]],
             continue
         if phase == "queued":
             created.add(trial)
+            if (ev.get("info") or {}).get("parent") is not None:
+                # Fork-eligible: a parent-carrying schedule entry (ASHA
+                # promotion, PBT segment, BO near-duplicate). Whether it
+                # actually forked is decided by its forked_from edge.
+                parented.add(trial)
         elif phase == "running":
             pid = ev.get("partition")
             if pid is not None:
@@ -249,6 +273,8 @@ def derive(events: List[Dict[str, Any]],
             misses += 1
         elif phase == "compiled":
             compiled_recs.setdefault(trial, ev)
+        elif phase == "forked_from":
+            forked.setdefault(trial, ev)
         elif phase == "preempted":
             preempted_at.setdefault(trial, []).append(t)
         elif phase == "resumed":
@@ -259,6 +285,8 @@ def derive(events: List[Dict[str, Any]],
         elif phase == "requeued":
             requeues += 1
             requeued_at.setdefault(trial, []).append(t)
+            if ev.get("reason") == "fork_source_lost":
+                fork_downgrades += 1
         elif phase == "finalized":
             finalized += 1
             if ev.get("error"):
@@ -352,6 +380,29 @@ def derive(events: List[Dict[str, Any]],
                 "hits": cache_hits, "misses": cache_misses,
                 "hit_rate": round(cache_hits / (cache_hits + cache_misses),
                                   3)}
+    # Checkpoint-forking search: genealogy + the compute the forks saved.
+    # forked = trials dispatched with a forked_from edge; from_scratch =
+    # parent-carrying schedule entries (promotions/exploits) that ran
+    # without one (fork off, parent never checkpointed, or downgraded);
+    # steps_saved = parent steps NOT re-trained (the fork points summed);
+    # fork_load_ms = the runner-measured checkpoint staging cost (from
+    # the compiled records).
+    fork_block: Dict[str, Any] = {}
+    if forked or parented or ckpt_gcs:
+        load_ms = [float(r["fork_load_ms"])
+                   for r in compiled_recs.values()
+                   if r.get("fork_load_ms") is not None]
+        fork_block = {
+            "forked": len(forked),
+            "from_scratch": len(parented - set(forked)),
+            # A fork at step S skips re-training steps 0..S: S+1 saved.
+            "steps_saved": sum(int(e["step"]) + 1
+                               for e in forked.values()
+                               if e.get("step") is not None),
+            "fork_load_ms": _dist_stats(load_ms),
+            "downgrades": fork_downgrades,
+            "ckpt_gc": ckpt_gcs,
+        }
     return {
         "trials": {"created": len(created), "finalized": finalized,
                    "early_stopped": len(early), "errors": errors,
@@ -362,4 +413,5 @@ def derive(events: List[Dict[str, Any]],
         "suggest": suggest,
         "preempt": preempt,
         "compile": compile_block,
+        "fork": fork_block,
     }

@@ -57,7 +57,8 @@ _SUB_SLICES = (
 _INSTANT_PHASES = ("suggested", "queued", "stop_flagged", "stop_sent",
                    "requeued", "lost", "profile_skipped", "prefetch_hit",
                    "prefetch_miss", "preempt_requested", "preempted",
-                   "resumed", "gang_assembled", "gang_released")
+                   "resumed", "gang_assembled", "gang_released",
+                   "forked_from")
 
 #: tid of the per-partition gang lane: a gang trial's busy interval is
 #: rendered as one slice on EVERY member partition's gang lane, so the
@@ -148,6 +149,35 @@ def build_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                             "args": {k: v for k, v in ev.items()
                                      if k not in ("ev", "t")}})
 
+    # Fork genealogy flow arrows (checkpoint-forking search): one
+    # Perfetto flow per forked_from edge, from the PARENT's finalized
+    # point (the end of its trial slice — where the forked checkpoint
+    # was last written) to the CHILD's running edge on its own
+    # partition track. Lineage is literally visible: promotion chains
+    # render as arrows climbing the rung ladder across runner tracks.
+    fork_flows = 0
+    fin_point: Dict[str, tuple] = {}
+    for trial_id, evs in by_trial.items():
+        fin = next((e for e in evs if e.get("phase") == "finalized"), None)
+        if fin is not None:
+            fin_point[trial_id] = (us(fin["t"]), _pid(fin.get("partition")))
+    for trial_id, evs in by_trial.items():
+        fork = next((e for e in evs if e.get("phase") == "forked_from"),
+                    None)
+        if fork is None:
+            continue
+        src = fin_point.get(fork.get("parent"))
+        if src is None:
+            continue  # parent finalized outside this journal window
+        dst = next((e for e in evs if e.get("phase") == "running"), fork)
+        fork_flows += 1
+        fid = "fork-{}".format(fork_flows)
+        out.append({"name": "fork-flow", "cat": "flow", "ph": "s",
+                    "id": fid, "ts": src[0], "pid": src[1], "tid": 0})
+        out.append({"name": "fork-flow", "cat": "flow", "ph": "f",
+                    "bp": "e", "id": fid, "ts": us(dst["t"]),
+                    "pid": _pid(dst.get("partition")), "tid": 0})
+
     # Gang lanes: each assembled gang renders one slice per MEMBER
     # partition (gang lane, tid GANG_TID) spanning gang_assembled ->
     # gang_released, so an N-chip gang is a grouped band across N
@@ -196,7 +226,8 @@ def build_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "otherData": {"source": "maggy_tpu.telemetry",
                           "t0_unix_s": t0,
                           "partitions": sorted(partitions),
-                          "trials": len(by_trial)}}
+                          "trials": len(by_trial),
+                          "fork_flows": fork_flows}}
 
 
 def _gang_band(trial_id: str, assembled: Dict[str, Any], end_us: int,

@@ -32,6 +32,15 @@ SPAN_PHASES = (
     # block's chips returned to the pool (fields: members, chips; the
     # pair brackets the trial's N-chip busy interval in replay_pack).
     "gang_assembled", "gang_released",
+    # Checkpoint-forking search (docs/user.md "Forking search"): this
+    # trial was dispatched to RESUME from another trial's checkpoint —
+    # an ASHA promotion continuing its rung parent, a PBT exploit
+    # copying the winner, a BO near-duplicate warm start. Fields:
+    # parent (the source trial id), step (the checkpoint step forked
+    # from), partition. The genealogy edge trace.py renders as a
+    # parent→child Perfetto flow arrow and derive()'s fork block counts
+    # steps_saved from.
+    "forked_from",
 )
 
 #: Top-level journal event kinds (the ``ev`` field).
@@ -90,6 +99,12 @@ EVENT_KINDS = frozenset({
                               #   util.claim_driver_epoch — the seam
                               #   crash-only recovery and invariant 13
                               #   split a multi-incarnation journal on
+    "ckpt_gc",                # checkpoint garbage collection: a parent
+                              #   rung's checkpoint dir retired once no
+                              #   live or schedulable child can still
+                              #   fork from it (trial, parent of no one
+                              #   pending — fields: trial, why; bounds
+                              #   disk growth of forking sweeps)
 })
 
 #: ``reason=`` on a trial ``requeued`` phase: why it re-entered the
@@ -101,6 +116,12 @@ REQUEUE_REASONS = frozenset({
     "preempted",        # graceful scheduler preemption (resume-capable)
     "gang_member_lost",  # a gang member died: whole lease revoked, the
                          # trial reassembles a fresh gang (exactly once)
+    "fork_source_lost",  # a forked trial's staged checkpoint AND its
+                         # parent's vanished before re-dispatch (disk
+                         # loss / raced GC): the fork is downgraded to a
+                         # from-scratch run — journaled so genealogy
+                         # shows the downgrade instead of a silent
+                         # restart-at-0
 })
 
 #: ``reason=`` on a ``profile_captured`` event: what triggered the
@@ -166,6 +187,11 @@ CHAOS_KINDS = frozenset({
     # process that owns the chaos engine, so no in-process plan can
     # record it — the soak appends the record to the quiesced journal.
     "kill_driver",
+    # Fork soak (chaos/harness.py run_fork_soak, `--fork`): the runner
+    # a forked trial was just dispatched to is killed (plan kind, fired
+    # on_phase=forked_from) — invariant 14: exactly-once requeue
+    # resuming from the SAME fork point, genealogy intact.
+    "kill_fork",
 })
 
 #: Health-engine event fields (``ev: "health"``).

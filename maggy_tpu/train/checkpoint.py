@@ -83,3 +83,98 @@ def restore_parent_state(exp_dir: str, parent_trial_id: str,
         return ckpt.restore(abstract_state)
     finally:
         ckpt.close()
+
+
+# ------------------------------------------------- cross-trial forking
+
+def latest_checkpoint_step_env(env, trial_dir: str) -> Optional[int]:
+    """``latest_checkpoint_step`` through the environment abstraction, so
+    the DRIVER can resolve a parent's ack'd checkpoint step at fork-stamp
+    time on local fs AND GCS (the local helper above stays the runner's
+    import-free fast path)."""
+    path = "{}/checkpoints".format(trial_dir)
+    if not env.isdir(path):
+        return None
+    steps = [int(name) for name in env.ls(path) if name.isdigit()]
+    return max(steps) if steps else None
+
+
+def _copy_tree_env(env, src: str, dst: str) -> int:
+    """Recursive env-abstracted copy (returns files copied). Used by the
+    fork staging below for envs with no local filesystem (GCS).
+    Byte-exact by construction: checkpoint artifacts are opaque data, so
+    every file round-trips as bytes — no text-mode encoding detour."""
+    copied = 0
+    env.mkdir(dst)
+    for name in env.ls(src):
+        s, d = "{}/{}".format(src, name), "{}/{}".format(dst, name)
+        if env.isdir(s):
+            copied += _copy_tree_env(env, s, d)
+        else:
+            with env.open_file(s, "rb") as f:
+                data = f.read()
+            with env.open_file(d, "wb") as out:
+                out.write(data)
+            copied += 1
+    return copied
+
+
+def fork_checkpoint(env, exp_dir: str, parent_trial_id: str,
+                    child_trial_dir: str,
+                    step: Optional[int] = None) -> Optional[int]:
+    """Stage the parent trial's checkpoint into the child's trial dir so
+    the child RESUMES instead of re-training — the cross-trial
+    generalization of PR 5's same-trial resume (``ctx.resume_step``). The
+    copy makes the child self-contained: its own ``restore_checkpoint``
+    works unchanged, a requeued fork re-stages idempotently, and the
+    parent's dir stays intact for siblings (a PBT winner may donate to
+    several exploiting members).
+
+    ``step``: the specific checkpoint step to stage (None = the parent's
+    latest). Returns the staged step, or None when the parent has no
+    usable checkpoint (the caller falls back to a from-scratch run).
+    Idempotent AND crash-safe: a child that already holds a COMPLETE
+    copy of the step (a re-dispatched requeue, or a raced double-stage)
+    returns it without copying, while a copy torn by a mid-staging death
+    (the kill-mid-fork chaos scenario) is detected and re-copied — the
+    local path publishes atomically (tmp dir + os.replace), the env
+    path writes a ``.fork_complete.<step>`` marker LAST (next to the
+    step dir, never inside it — orbax must not see foreign files — and
+    non-digit, so ``latest_checkpoint_step`` never counts it)."""
+    target = step
+    parent_dir = "{}/{}".format(exp_dir, parent_trial_id)
+    if target is None:
+        target = latest_checkpoint_step_env(env, parent_dir)
+        if target is None:
+            return None
+    local = getattr(env, "FAST_LOCAL_WRITES", False)
+    child_step_dir = "{}/checkpoints/{}".format(child_trial_dir, target)
+    marker = "{}/checkpoints/.fork_complete.{}".format(child_trial_dir,
+                                                       target)
+    if env.isdir(child_step_dir) and (local or env.exists(marker)):
+        # Already staged (local publishes are atomic; remote copies are
+        # complete iff the marker landed) — or the child checkpointed
+        # this step itself on a local fs, which is just as restorable.
+        return int(target)
+    src = "{}/checkpoints/{}".format(parent_dir, target)
+    if not env.isdir(src):
+        return None
+    if local and os.path.isdir(src):
+        # Local fs fast path: one shutil tree copy, no per-file env hops.
+        import shutil
+
+        os.makedirs(os.path.dirname(child_step_dir), exist_ok=True)
+        tmp = child_step_dir + ".fork_tmp"
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        shutil.copytree(src, tmp)
+        # Atomic publish: a crash mid-copy leaves only the tmp dir, which
+        # the next staging attempt replaces — latest_checkpoint_step
+        # never sees a half-copied step (its name is not a digit).
+        os.replace(tmp, child_step_dir)
+    else:
+        # Re-copy overwrites a torn partial byte-for-byte; the marker
+        # write is the publish point.
+        _copy_tree_env(env, src, child_step_dir)
+        env.dump("{}", marker)
+    return int(target)

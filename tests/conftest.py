@@ -90,6 +90,14 @@ def pytest_configure(config):
         "slow. Select with -m failover.")
     config.addinivalue_line(
         "markers",
+        "fork: checkpoint-forking search tests — fork/copy staging, the "
+        "driver's fork stamp + genealogy + checkpoint GC, bitwise "
+        "fork-parity e2e, parent-affinity scheduling, and the offline "
+        "invariant-14 checker. The kill-mid-fork soak is `python -m "
+        "maggy_tpu.chaos --fork`; the A/B gate is `bench.py --fork`. "
+        "Select with -m fork.")
+    config.addinivalue_line(
+        "markers",
         "sink: fleet-wide telemetry fan-in tests (maggy_tpu.telemetry."
         "sink) — the JSINK journal sink service, client shipper "
         "degrade/re-ship exactly-once seam (invariant 12), clock-offset "
