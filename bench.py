@@ -182,6 +182,19 @@ def log(msg):
     print("[bench] {}".format(msg), file=sys.stderr, flush=True)
 
 
+def _current_platform():
+    """The substrate THIS process measures on — stamped into every
+    detail block (the ROADMAP flaky-TPU note: numbers are only
+    comparable within one platform) and checked by the A/B parity
+    comparator, which refuses to compare mixed-platform arms."""
+    try:
+        import jax
+
+        return str(jax.default_backend())
+    except Exception:  # noqa: BLE001 - a stamp, never a failure
+        return os.environ.get("JAX_PLATFORMS") or "unknown"
+
+
 def run_compile_ab(trials=None, workers=1):
     """Repeat-shape warm_start A/B (ROADMAP item 3's gate): the SAME
     fixed-shape random-search sweep run twice on the SAME platform — warm
@@ -230,6 +243,11 @@ def run_compile_ab(trials=None, workers=1):
             "warm_misses": comp.get("warm_misses", 0),
             "ttfm_warm": comp.get("ttfm_warm") or {},
             "ttfm_cold": comp.get("ttfm_cold") or {},
+            # The arm's chip-time ledger + platform: --goodput gates
+            # warm-vs-cold COMPILE badput on these, and the stamp feeds
+            # the same-platform refusal.
+            "goodput": derived.get("goodput") or {},
+            "platform": _current_platform(),
         }
     warm_p50 = (out["warm"]["ttfm_warm"] or {}).get("median_ms")
     cold_p50 = (out["warm"]["ttfm_cold"] or {}).get("median_ms")
@@ -300,6 +318,10 @@ def scheduling_telemetry(exp_dir, trial_dicts):
             # cold/warm, phase breakdown, persistent-cache counters
             # (empty for warm_start=False or pre-warm journals).
             "compile": derived.get("compile") or {},
+            # Chip-time goodput ledger: where every held chip-second of
+            # the sweep went (train vs init/compile/ckpt/rework/handoff/
+            # queue_wait/idle badput, unaccounted residual).
+            "goodput": derived.get("goodput") or {},
             "source": "telemetry_journal",
             "journal": journal,
         }
@@ -307,6 +329,7 @@ def scheduling_telemetry(exp_dir, trial_dicts):
             "early_stop_reaction": {},
             "suggest": {},
             "compile": {},
+            "goodput": {},
             "source": "trial_json_fallback"}
 
 
@@ -690,8 +713,10 @@ def headline_main():
             "early_stop_reaction": sched["early_stop_reaction"],
             "suggest": sched["suggest"],
             "compile": sched["compile"],
+            "goodput": sched["goodput"],
             "compile_ab": compile_ab,
             "handoff_source": sched["source"],
+            "platform": _current_platform(),
             "trace": trace_path,
             "analysis": analysis_detail(),
         },
@@ -760,6 +785,8 @@ def chaos_main():
             "health": report.get("health"),
             "obs": report.get("obs"),
             "client_retries": report["client_retries"],
+            "goodput": report.get("goodput"),
+            "platform": _current_platform(),
             "journal": report["journal"],
             # The soak timeline (chaos injections + health flags as
             # instant markers): validated perfetto-loadable or None.
@@ -774,6 +801,19 @@ def chaos_main():
     return 0 if report["ok"] else 1
 
 
+def _journal_goodput(journal_path):
+    """Fold one journal's chip-time goodput ledger for a detail block
+    (best-effort: a missing/torn journal yields {} rather than costing
+    the bench)."""
+    try:
+        from maggy_tpu.telemetry import read_events
+        from maggy_tpu.telemetry.goodput import compute_goodput
+
+        return compute_goodput(read_events(journal_path))
+    except Exception as e:  # noqa: BLE001 - accounting must not fail a gate
+        return {"error": repr(e)}
+
+
 def _finalized_ids(events):
     """Finalized trial ids of a journal (content-addressed over params,
     so two runs of the same seeded schedule produce identical sets)."""
@@ -783,18 +823,34 @@ def _finalized_ids(events):
 
 
 def journal_schedule_parity(events_a, events_b,
-                            label_a="a", label_b="b"):
+                            label_a="a", label_b="b",
+                            platform_a=None, platform_b=None):
     """Journal-replayed A/B schedule comparator — the ONE home of the
     same-platform-baseline parity rule (ROADMAP flaky-TPU note): two
     arms of an A/B (``--fork`` forking-on vs forking-off), or a
     recovered run vs an uninterrupted reference (``--failover``),
     executed the SAME schedule exactly when their finalized trial-id
     sets match. Returns {match, <label_a>, <label_b>,
-    symmetric_difference}."""
+    symmetric_difference, platform?}.
+
+    When both arms carry a platform stamp the comparator REFUSES a
+    mixed-platform comparison outright (ValueError naming both sides):
+    a cross-substrate A/B is not a measurement, and silently returning
+    numbers would let one into a BENCH artifact."""
+    if platform_a is not None and platform_b is not None \
+            and platform_a != platform_b:
+        raise ValueError(
+            "refusing cross-platform A/B: arm {!r} ran on {!r} but arm "
+            "{!r} ran on {!r} — re-run both arms on one platform "
+            "(ROADMAP flaky-TPU comparability note)".format(
+                label_a, platform_a, label_b, platform_b))
     ids_a, ids_b = _finalized_ids(events_a), _finalized_ids(events_b)
-    return {"match": ids_a == ids_b,
-            label_a: len(ids_a), label_b: len(ids_b),
-            "symmetric_difference": sorted(set(ids_a) ^ set(ids_b))}
+    out = {"match": ids_a == ids_b,
+           label_a: len(ids_a), label_b: len(ids_b),
+           "symmetric_difference": sorted(set(ids_a) ^ set(ids_b))}
+    if platform_a is not None:
+        out["platform"] = platform_a
+    return out
 
 
 def rung0_events(events):
@@ -867,9 +923,12 @@ def failover_main():
                                           JOURNAL_NAME))
     soak_events = read_events(report["journal"])
 
+    platform = _current_platform()
     parity_rec = journal_schedule_parity(soak_events, ref_events,
                                          label_a="soak_trials",
-                                         label_b="reference_trials")
+                                         label_b="reference_trials",
+                                         platform_a=platform,
+                                         platform_b=platform)
     parity = parity_rec["match"]
     if not parity:
         violations.append(
@@ -898,6 +957,10 @@ def failover_main():
             "requeued": report["trials"]["requeued"],
             "recoveries": report["failover"]["recoveries"],
             "parity": parity_rec,
+            # The multi-incarnation ledger: killed attempts surface as
+            # rework badput, the restart seam as handoff/queue_wait.
+            "goodput": _journal_goodput(report["journal"]),
+            "platform": platform,
             "witness": report.get("witness"),
             "journal": report["journal"],
         }},
@@ -958,6 +1021,10 @@ def fork_main():
             searchspace=Searchspace(lr=("DOUBLE", [0.05, 0.2])),
             direction="max", num_workers=workers, hb_interval=0.02,
             es_policy="none", seed=seed, fork=fork_on,
+            # prefetch invalidation re-draws dropped rung-0 samples with
+            # fresh RNG state, making the rung-0 id set timing-dependent;
+            # the schedule-parity gate needs strictly sequential draws.
+            prefetch=False,
             experiment_dir=arm_dir)
         t0 = time.time()
         experiment.lagom(fork_ckpt_train_fn, config)
@@ -973,6 +1040,7 @@ def fork_main():
             "wall_s": round(wall, 2), "events": events,
             "trials": trial_dicts,
             "derived": replay_journal(os.path.join(exp_dir, JOURNAL_NAME)),
+            "platform": _current_platform(),
         }
         log("{} arm: {} trials in {:.1f}s (fork block: {})".format(
             arm, len(trial_dicts), wall,
@@ -1070,7 +1138,9 @@ def fork_main():
     schedule_parity = journal_schedule_parity(
         rung0_events(arms["fork"]["events"]),
         rung0_events(arms["scratch"]["events"]),
-        label_a="fork_trials", label_b="scratch_trials")
+        label_a="fork_trials", label_b="scratch_trials",
+        platform_a=arms["fork"]["platform"],
+        platform_b=arms["scratch"]["platform"])
     if not schedule_parity["match"]:
         violations.append(
             "arms executed different rung-0 schedules: symmetric "
@@ -1108,6 +1178,168 @@ def fork_main():
             "wall_fork_off_s": arms["scratch"]["wall_s"],
             "fork": arms["fork"]["derived"].get("fork"),
             "fork_off": arms["scratch"]["derived"].get("fork"),
+            # Per-arm chip-time ledgers: forking-on must show as LESS
+            # rework badput than from-scratch (--goodput gates this on
+            # its own smaller A/B; recorded here for the trajectory).
+            "goodput": arms["fork"]["derived"].get("goodput"),
+            "goodput_off": arms["scratch"]["derived"].get("goodput"),
+        }},
+    }), flush=True)
+    return 0 if ok else 1
+
+
+def goodput_main():
+    """``bench.py --goodput``: the chip-time ledger gate. Two
+    journal-replayed A/Bs on ONE pinned platform prove the ledger
+    measures what it claims:
+
+    (a) warm-start A/B (run_compile_ab): the warm arm's COMPILE badput
+        chip-seconds must land strictly below the cold arm's — the
+        compile-once win shows up as measured badput reduction, not
+        just a ttfm distribution;
+    (b) fork A/B (small ASHA sweep, forking on vs off): the forking
+        arm's REWORK badput must land strictly below from-scratch — a
+        from-scratch promotion re-trains its parent's prefix and the
+        accountant books exactly that time as rework;
+    (c) every arm's ``unaccounted`` residual stays <= 5% of held
+        chip-time — the taxonomy is closed, a leak fails the gate;
+    (d) both fork arms carry the SAME platform stamp
+        (journal_schedule_parity raises on a mixed-platform A/B).
+
+    CPU-pinned like --fork (closed-form/tiny trial bodies; the ledger
+    under test is platform-independent journal arithmetic). Exit 1 on
+    any gate failure."""
+    if "MAGGY_TPU_BASE_DIR" not in os.environ:
+        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    for var in _ACCEL_BOOTSTRAP_VARS:
+        os.environ.pop(var, None)
+    _force_cpu_if_requested()
+    import glob as _glob
+
+    from maggy_tpu import OptimizationConfig, Searchspace, experiment
+    from maggy_tpu.chaos.harness import fork_ckpt_train_fn
+    from maggy_tpu.optimizers import Asha
+    from maggy_tpu.telemetry import (JOURNAL_NAME, read_events,
+                                     replay_journal)
+
+    seed = int(os.environ.get("BENCH_GOODPUT_SEED", "7"))
+    rf = 3
+    # ASHA's rung ladder needs rf**2 trials to build all three rungs.
+    trials = max(int(os.environ.get("BENCH_GOODPUT_TRIALS", "9")), rf * rf)
+    bound = float(os.environ.get("BENCH_GOODPUT_UNACCOUNTED", "0.05"))
+    t_start = time.time()
+    violations = []
+
+    def _bucket(gp, name):
+        return ((gp or {}).get("buckets") or {}).get(name) or 0.0
+
+    # (a) warm-start A/B — run_compile_ab already replays each arm's
+    # journal; its per-arm blocks now carry the goodput ledger.
+    compile_ab = run_compile_ab()
+    ledgers = {"warm": compile_ab["warm"]["goodput"],
+               "cold": compile_ab["cold"]["goodput"]}
+    warm_compile = sum(_bucket(ledgers["warm"], b)
+                       for b in ("init", "trace", "compile"))
+    cold_compile = sum(_bucket(ledgers["cold"], b)
+                       for b in ("init", "trace", "compile"))
+    if not warm_compile < cold_compile:
+        violations.append(
+            "warm-start did not show as measured compile badput "
+            "reduction: warm arm {:.2f}s (init+trace+compile) vs cold "
+            "arm {:.2f}s".format(warm_compile, cold_compile))
+    log("warm A/B compile badput: warm {:.2f}s vs cold {:.2f}s".format(
+        warm_compile, cold_compile))
+
+    # (b) fork A/B — the --fork sweep at reduced size, gated on the
+    # ledger's REWORK bucket instead of re-trained step counts.
+    events_by_arm = {}
+    for arm, fork_on in (("fork", True), ("scratch", False)):
+        arm_dir = os.path.join(os.environ["MAGGY_TPU_BASE_DIR"],
+                               "goodput_ab_{}".format(arm))
+        config = OptimizationConfig(
+            name="bench_goodput_{}".format(arm), num_trials=trials,
+            optimizer=Asha(reduction_factor=rf, resource_min=1,
+                           resource_max=rf * rf, seed=seed),
+            searchspace=Searchspace(lr=("DOUBLE", [0.05, 0.2])),
+            direction="max", num_workers=3, hb_interval=0.02,
+            es_policy="none", seed=seed, fork=fork_on,
+            # prefetch invalidation re-draws dropped rung-0 samples with
+            # fresh RNG state, making the rung-0 id set timing-dependent;
+            # the schedule-parity gate needs strictly sequential draws.
+            prefetch=False,
+            experiment_dir=arm_dir)
+        experiment.lagom(fork_ckpt_train_fn, config)
+        exp_dir = sorted(d for d in _glob.glob(os.path.join(arm_dir, "*"))
+                         if os.path.isdir(d))[-1]
+        events_by_arm[arm] = read_events(
+            os.path.join(exp_dir, JOURNAL_NAME))
+        ledgers[arm] = replay_journal(
+            os.path.join(exp_dir, JOURNAL_NAME)).get("goodput") or {}
+    fork_rework = _bucket(ledgers["fork"], "rework")
+    scratch_rework = _bucket(ledgers["scratch"], "rework")
+    if not fork_rework < scratch_rework:
+        violations.append(
+            "forking did not show as measured rework badput reduction: "
+            "forking-on {:.2f}s rework vs from-scratch {:.2f}s".format(
+                fork_rework, scratch_rework))
+    log("fork A/B rework badput: fork {:.2f}s vs scratch {:.2f}s".format(
+        fork_rework, scratch_rework))
+
+    # (c) closed taxonomy: no arm may leak more than the bound.
+    for arm, gp in sorted(ledgers.items()):
+        if not gp:
+            violations.append(
+                "arm {} produced no goodput ledger (empty journal "
+                "fold)".format(arm))
+            continue
+        frac = gp.get("unaccounted_fraction")
+        if frac is None or frac > bound:
+            violations.append(
+                "arm {} unaccounted chip-time {} exceeds the {:.0%} "
+                "bound".format(arm, frac, bound))
+
+    # (d) same-platform rule: the comparator itself raises on a
+    # mixed-platform A/B, so a green parity record certifies the stamp.
+    platform = _current_platform()
+    try:
+        parity = journal_schedule_parity(
+            rung0_events(events_by_arm["fork"]),
+            rung0_events(events_by_arm["scratch"]),
+            label_a="fork_trials", label_b="scratch_trials",
+            platform_a=platform, platform_b=platform)
+        if not parity["match"]:
+            violations.append(
+                "fork A/B arms executed different rung-0 schedules: "
+                "symmetric difference {}".format(
+                    parity["symmetric_difference"]))
+    except ValueError as e:
+        parity = {"match": False, "error": str(e)}
+        violations.append(str(e))
+
+    ok = not violations
+    print(json.dumps({
+        "metric": "chip-time goodput ledger (warm + fork A/B, "
+                  "journal-replayed)",
+        "value": 1.0 if ok else 0.0,
+        "unit": "goodput_gate_ok",
+        "detail": {"goodput_gate": {
+            "seed": seed, "trials": trials,
+            "wall_s": round(time.time() - t_start, 1),
+            "platform": platform,
+            "violations": violations,
+            "unaccounted_bound": bound,
+            "compile_badput_s": {"warm": round(warm_compile, 3),
+                                 "cold": round(cold_compile, 3)},
+            "rework_s": {"fork": round(fork_rework, 3),
+                         "scratch": round(scratch_rework, 3)},
+            "schedule_parity": parity,
+            "arms": {arm: {
+                "goodput_fraction": gp.get("goodput_fraction"),
+                "unaccounted_fraction": gp.get("unaccounted_fraction"),
+                "held_chip_s": gp.get("held_chip_s"),
+                "badput_top": gp.get("badput_top"),
+            } for arm, gp in sorted(ledgers.items()) if gp},
         }},
     }), flush=True)
     return 0 if ok else 1
@@ -1140,6 +1372,10 @@ def fleet_main():
             "violations": report["violations"],
             "results": report["results"],
             "fleet": report["detail"],
+            # The fleet replay's per-tenant ledger roll-up (also inside
+            # detail.fleet.goodput; hoisted for the trajectory reader).
+            "goodput": (report["detail"] or {}).get("goodput"),
+            "platform": _current_platform(),
             "journal": report["journal"],
         },
     }), flush=True)
@@ -1890,6 +2126,8 @@ if __name__ == "__main__":
         sys.exit(failover_main())
     if "--fork" in sys.argv:
         sys.exit(fork_main())
+    if "--goodput" in sys.argv:
+        sys.exit(goodput_main())
     if "--fleet" in sys.argv:
         sys.exit(fleet_main())
     if "--pack" in sys.argv:

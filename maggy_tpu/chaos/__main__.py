@@ -15,6 +15,7 @@ the standard soak runs: a runner killed mid-trial, a false preemption,
     python -m maggy_tpu.chaos --sink                     # sink-kill soak
     python -m maggy_tpu.chaos --driver                   # driver-kill soak
     python -m maggy_tpu.chaos --fork                     # fork-kill soak
+    python -m maggy_tpu.chaos --goodput                  # fault-free ledger soak
     python -m maggy_tpu.chaos --show-schedule --seed 7   # no experiment
 
 ``--preempt`` runs the graceful-preemption soak: a mid-trial trial is
@@ -105,6 +106,12 @@ def main(argv=None) -> int:
                          "SAME fork point, genealogy intact; plus one "
                          "fork across lagom(..., resume=True) driver "
                          "failover (invariant 14)")
+    ap.add_argument("--goodput", action="store_true",
+                    help="run the fault-free goodput-ledger control soak "
+                         "(invariant 15's clean half): with zero faults "
+                         "injected the chip-time fold must book ~zero "
+                         "rework and keep the unaccounted residual at or "
+                         "under 5% of held chip-time")
     ap.add_argument("--agent", action="store_true",
                     help="run the remote-agent soak: real agent daemon "
                          "processes (python -m maggy_tpu.fleet agent) "
@@ -150,13 +157,23 @@ def main(argv=None) -> int:
     from maggy_tpu.chaos.plan import FaultPlan
 
     modes = [m for m in ("stall", "piggyback", "preempt", "gang", "agent",
-                         "sink", "driver", "fork")
+                         "sink", "driver", "fork", "goodput")
              if getattr(args, m)]
     if args.plan and modes:
         ap.error("--{} uses a built-in plan; drop --plan".format(modes[0]))
     if len(modes) > 1:
         ap.error("pick one of --stall / --piggyback / --preempt / --gang "
-                 "/ --agent / --sink / --driver / --fork")
+                 "/ --agent / --sink / --driver / --fork / --goodput")
+    if args.goodput:
+        # The goodput control soak owns its whole config (an EMPTY
+        # fault plan — the gate is on the ledger, not a recovery) —
+        # delegate wholesale.
+        report = harness.run_goodput_soak(
+            seed=7 if args.seed is None else args.seed,
+            num_trials=args.trials, workers=args.workers,
+            lock_witness=not args.no_witness)
+        print(json.dumps(report, indent=2, default=str))
+        return 0 if report["ok"] else 1
     if args.fork:
         # The fork soak owns its whole config (forking ASHA sweep +
         # checkpointing train fn + the synthetic driver-failover half) —

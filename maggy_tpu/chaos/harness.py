@@ -818,6 +818,45 @@ def run_soak(plan: Optional[FaultPlan] = None, seed: int = 7,
     return report
 
 
+def run_goodput_soak(seed: int = 7, num_trials: int = 12,
+                     workers: int = 3,
+                     lock_witness: Optional[bool] = None
+                     ) -> Dict[str, Any]:
+    """Fault-free control soak for the chip-time ledger (invariant 15's
+    other half): with NO faults injected, the goodput fold over the
+    journal must book (a) ~zero rework chip-time — rework exists only
+    where a seam exists — and (b) an ``unaccounted`` residual at or
+    under 5% of held chip-time, proving the taxonomy closes on a clean
+    run. An empty FaultPlan legitimately skips run_soak's
+    nothing-injected check (that check guards plans WITH specs)."""
+    report = run_soak(plan=FaultPlan([], seed), seed=seed,
+                      num_trials=num_trials, workers=workers,
+                      # Generous loss bound: a slow CI host must not
+                      # manufacture a heartbeat-loss seam (and thus
+                      # legitimate rework) in the fault-free control.
+                      hb_loss_timeout=2.0,
+                      lock_witness=lock_witness)
+    gp = report.get("goodput") or {}
+    rework_s = ((gp.get("buckets") or {}).get("rework") or 0.0)
+    if rework_s > 1e-6:
+        report["violations"].append(
+            "rework in a fault-free soak: the ledger books {:.3f}s "
+            "rework chip-time with zero faults injected (trials: "
+            "{})".format(rework_s,
+                         (report.get("rework") or {}).get("trials")))
+    unaccounted = gp.get("unaccounted_fraction")
+    if not gp:
+        report["violations"].append(
+            "no goodput ledger: the fold over the soak journal came "
+            "back empty")
+    elif unaccounted is None or unaccounted > 0.05:
+        report["violations"].append(
+            "unaccounted chip-time {} exceeds the 5% bound in a "
+            "fault-free soak: the taxonomy leaks".format(unaccounted))
+    report["ok"] = not report["violations"]
+    return report
+
+
 def check_invariants(events: List[Dict[str, Any]],
                      requeue_bound_s: Optional[float] = None,
                      stall_flag_bound_s: Optional[float] = 15.0
@@ -843,6 +882,7 @@ def check_invariants(events: List[Dict[str, Any]],
     forked_evs: Dict[str, List[Dict[str, Any]]] = {}
     gang_assembled: Dict[str, List[Dict[str, Any]]] = {}
     gang_released: Dict[str, List[Dict[str, Any]]] = {}
+    parent_of: Dict[str, Any] = {}
     chaos_events: List[Dict[str, Any]] = []
     health_raised: List[Dict[str, Any]] = []
     health_by_check: Dict[str, int] = {}
@@ -893,6 +933,8 @@ def check_invariants(events: List[Dict[str, Any]],
             continue
         if phase == "queued":
             queued.setdefault(trial, t)
+            if (ev.get("info") or {}).get("parent") is not None:
+                parent_of.setdefault(trial, ev["info"]["parent"])
         elif phase == "requeued":
             requeued.setdefault(trial, []).append(t)
             requeued_evs.setdefault(trial, []).append(dict(ev))
@@ -1252,6 +1294,51 @@ def check_invariants(events: List[Dict[str, Any]],
             rec["mttr_s"] = round(min(r["t"] for r in recovers) - t0, 3)
         failover_recs.append(rec)
 
+    # Invariant 15: rework chip-time lands EXACTLY on the trials whose
+    # attempts the journal shows torn — a runner-death requeue seam
+    # (requeued with a non-preempt reason) or a from-scratch promotion
+    # (parent recorded but no forked_from edge). The goodput accountant
+    # folds the SAME events; a rework second booked on an un-seamed
+    # trial means the ledger mis-attributes (and a fault-free soak must
+    # book ~zero rework at all — run_goodput_soak gates that side).
+    from maggy_tpu.telemetry.goodput import compute_goodput
+
+    goodput = compute_goodput(events)
+    per_trial_gp = goodput.get("per_trial") or {}
+    seamed = {trial for trial, evs in requeued_evs.items()
+              if any(e.get("reason") != "preempted" for e in evs)}
+    seamed |= {trial for trial, parent in parent_of.items()
+               if parent is not None and trial not in forked_evs}
+    rework_trials: Dict[str, float] = {}
+    for trial, buckets in sorted(per_trial_gp.items()):
+        rw = buckets.get("rework") or 0.0
+        if rw > 1e-6:
+            rework_trials[trial] = round(rw, 3)
+            if trial not in seamed:
+                violations.append(
+                    "rework misattributed: trial {} books {:.3f}s rework "
+                    "chip-time but the journal shows no requeue seam or "
+                    "from-scratch promotion for it".format(trial, rw))
+    # The positive half: a runner-death fault that tore a STARTED
+    # attempt (a running edge precedes the kill) and forced a requeue
+    # must show up as rework on that trial — dead-attempt seconds can
+    # never fall into unaccounted.
+    for ce in chaos_events:
+        if ce.get("kind") not in ("kill_runner", "kill_fork"):
+            continue
+        trial, t0 = ce.get("trial"), ce.get("t")
+        if trial is None or t0 is None:
+            continue
+        if not [t for t in requeued.get(trial, []) if t >= t0]:
+            continue  # benign race: covered by the recovery checks above
+        if not [t for t in running_at.get(trial, []) if t <= t0]:
+            continue  # fault beat the first running edge: nothing torn
+        if (per_trial_gp.get(trial) or {}).get("rework", 0.0) <= 1e-6:
+            violations.append(
+                "rework not booked: {} fault requeued started trial {} "
+                "but the goodput ledger books zero rework chip-time for "
+                "it".format(ce["kind"], trial))
+
     by_kind: Dict[str, int] = {}
     for ce in chaos_events:
         by_kind[ce["kind"]] = by_kind.get(ce["kind"], 0) + 1
@@ -1276,6 +1363,13 @@ def check_invariants(events: List[Dict[str, Any]],
         "profiles": {"obs_armed": obs_armed,
                      "captured": len(profile_captures),
                      "auto": len(auto_captures)},
+        # Invariant 15 (chip-time goodput ledger): the full fold over
+        # this journal plus the rework attribution the invariant
+        # verified (seamed = trials allowed to book rework).
+        "goodput": goodput,
+        "rework": {"trials": rework_trials,
+                   "seamed": sorted(seamed),
+                   "booked_s": round(sum(rework_trials.values()), 3)},
         # Invariant 13 (crash-only driver failover): incarnation seams,
         # per-kill recovery outcome + MTTR, and how many pre-crash
         # runners re-bound to the restarted driver.

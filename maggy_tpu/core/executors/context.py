@@ -22,7 +22,20 @@ naming it in its signature — and gets:
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Dict, Optional
+
+
+def _note_ckpt(**fields: Any) -> None:
+    """Route checkpoint I/O timing into the current trial's RunnerStats
+    via the warm trial scope (same channel note_compile rides). Never
+    fatal: checkpoint accounting must not break checkpointing itself."""
+    try:
+        from maggy_tpu.train.warm import note_ckpt
+
+        note_ckpt(**fields)
+    except Exception:  # noqa: BLE001 - accounting is best-effort
+        pass
 
 
 def info_needs_fresh_state(info: Dict[str, Any]) -> bool:
@@ -145,13 +158,22 @@ class TrialContext:
         return self._checkpointer
 
     def save_checkpoint(self, step: int, state: Any) -> None:
-        self.checkpointer().save(step, state)
+        t0 = time.perf_counter()
+        try:
+            self.checkpointer().save(step, state)
+        finally:
+            _note_ckpt(save_ms=(time.perf_counter() - t0) * 1e3, saves=1)
 
     def restore_checkpoint(self, abstract_state: Any) -> Optional[Any]:
         """Resume this trial's own latest checkpoint (None if absent)."""
         if not os.path.isdir(os.path.join(self.trial_dir, "checkpoints")):
             return None
-        return self.checkpointer().restore(abstract_state)
+        t0 = time.perf_counter()
+        try:
+            return self.checkpointer().restore(abstract_state)
+        finally:
+            _note_ckpt(restore_ms=(time.perf_counter() - t0) * 1e3,
+                       restores=1)
 
     def restore_parent(self, abstract_state: Any) -> Optional[Any]:
         """Warm-start from the promoted parent's checkpoint (None if this
@@ -161,7 +183,12 @@ class TrialContext:
             return None
         from maggy_tpu.train.checkpoint import restore_parent_state
 
-        return restore_parent_state(self.exp_dir, parent, abstract_state)
+        t0 = time.perf_counter()
+        try:
+            return restore_parent_state(self.exp_dir, parent, abstract_state)
+        finally:
+            _note_ckpt(restore_ms=(time.perf_counter() - t0) * 1e3,
+                       restores=1)
 
     def close(self) -> None:
         if self._checkpointer is not None:

@@ -186,9 +186,17 @@ class ExperimentEntry:  # guarded-by: FleetScheduler._lock
             cap = min(cap, self.slots)
         return cap
 
-    def vtime(self, now: float) -> float:
+    def chip_seconds(self, now: float) -> float:
+        """Total chip-time this tenant has held: closed leases
+        (``service_s``) plus the live time of every still-open lease.
+        Fleet runners lease one chip each, so lease-seconds ==
+        chip-seconds; the goodput ledger divides this same number into
+        train vs badput buckets from the tenant's own journal."""
         live = sum(now - t0 for _, t0 in self.open_leases.values())
-        return (self.service_s + live) / self.policy.weight
+        return self.service_s + live
+
+    def vtime(self, now: float) -> float:
+        return self.chip_seconds(now) / self.policy.weight
 
     def ready(self) -> bool:
         return self.state == "active" and self.executor_fn is not None
@@ -207,6 +215,8 @@ class ExperimentEntry:  # guarded-by: FleetScheduler._lock
                 **self.policy.to_dict(),
                 "allocated": self.allocated(), "leases": self.lease_count,
                 "service_s": round(self.service_s, 3),
+                "chip_seconds": round(self.chip_seconds(time.monotonic()),
+                                      3),
                 "preemptions": self.preemptions,
                 "queue_wait_s": qw, "failures": len(self.failures),
                 "exp_dir": self.exp_dir}
@@ -782,6 +792,7 @@ class FleetScheduler:
         self._event("lease", exp=entry.name, runner=runner_idx, pid=pid,
                     phase="start", exp_dir=entry.exp_dir,
                     warm_hint=warm_hint, warm_affinity=warm_affinity)
+        self._chip_gauge(entry)
         return entry, pid
 
     def release_binding(self, runner_idx: int, entry: ExperimentEntry,
@@ -804,6 +815,7 @@ class FleetScheduler:
                             "error" if error is not None else "released"),
                         duration_s=round(time.monotonic() - held[1], 3)
                         if held is not None else None)
+            self._chip_gauge(entry)
             self._wake.notify_all()
 
     def runner_for(self, entry: ExperimentEntry,
@@ -925,6 +937,8 @@ class FleetScheduler:
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             entries = sorted(self._entries.values(), key=lambda e: e.seq)
+            experiments = list(self._finished) \
+                + [e.snapshot() for e in entries]
             return {
                 "fleet_size": self.fleet_size,
                 "agent_slots": len(self._agent_slots)
@@ -933,8 +947,13 @@ class FleetScheduler:
                 "active": len(self._active),
                 "shed": self.shed_count,
                 "max_queued": self.max_queued,
-                "experiments": list(self._finished)
-                + [e.snapshot() for e in entries],
+                # Fleet-wide chip-time granted so far (finished +
+                # resident tenants, live leases included) — the
+                # denominator the goodput ledger accounts against.
+                "chip_seconds": round(
+                    sum(e.get("chip_seconds") or e.get("service_s") or 0.0
+                        for e in experiments), 3),
+                "experiments": experiments,
             }
 
     def saturated(self) -> bool:
@@ -948,6 +967,22 @@ class FleetScheduler:
         telem = self.telemetry
         if telem is not None:
             telem.event(ev, **fields)
+
+    def _chip_gauge(self, entry: ExperimentEntry) -> None:
+        """Refresh the per-tenant ``tenant.chip_seconds.<exp>`` gauge on
+        a lease transition so the fleet's /metrics exposition carries
+        each tenant's granted chip-time (obs labels it
+        ``tenant_chip_seconds{tenant=...}``). Best-effort: gauges are a
+        read-side convenience, the journal stays the source of truth."""
+        telem = self.telemetry
+        if telem is None or not getattr(telem, "enabled", False):
+            return
+        try:
+            telem.metrics.gauge(
+                "tenant.chip_seconds.{}".format(entry.name)).set(
+                round(entry.chip_seconds(time.monotonic()), 3))
+        except Exception:  # noqa: BLE001 - accounting must not break leasing
+            pass
 
 
 class FleetSubmission:
@@ -1466,6 +1501,8 @@ def replay_fleet_journal(path: str, env=None,
     from maggy_tpu.telemetry import read_events
     from maggy_tpu.telemetry.spans import _dist_stats
 
+    if os.path.isdir(path):  # a fleet home dir stands in for its journal
+        path = os.path.join(path, "fleet.jsonl")
     events = read_events(path, env=env)
     exps: Dict[str, Dict[str, Any]] = {}
     preempts = 0
@@ -1602,6 +1639,66 @@ def replay_fleet_journal(path: str, env=None,
             "exp_dir": e["exp_dir"],
         }
 
+    # Per-tenant chip-time ledger: lease-derived chip-seconds (the
+    # denominator the scheduler granted) plus each tenant's OWN journal
+    # folded through the goodput accountant — local journal merged
+    # exactly-once with any sink-shipped segment, so a tenant that ran
+    # on a remote agent (no surviving local journal) still folds. A
+    # tenant's journal is written by one driver process, so the fold is
+    # single-clock; cross-process merges go through
+    # ``goodput.merge_corrected`` with the replay's ``clock_offsets``.
+    from maggy_tpu.telemetry import JOURNAL_NAME
+    from maggy_tpu.telemetry.goodput import compute_goodput
+
+    home = os.path.dirname(os.path.abspath(path))
+    shipped_by_source: Dict[str, Any] = {}
+    try:
+        from maggy_tpu.telemetry.sink import SINK_DIR_NAME, read_sink_dir
+
+        sink_dir = os.path.join(home, SINK_DIR_NAME)
+        if os.path.isdir(sink_dir):
+            shipped_by_source = read_sink_dir(sink_dir)
+    except Exception:  # noqa: BLE001 - a torn sink dir must not kill replay
+        shipped_by_source = {}
+    tenants: Dict[str, Dict[str, Any]] = {}
+    fleet_held = 0.0
+    fleet_train = 0.0
+    for name, oe in sorted(out_exps.items()):
+        local = None
+        exp_dir = oe.get("exp_dir")
+        if exp_dir:
+            jp = os.path.join(exp_dir, JOURNAL_NAME)
+            if os.path.exists(jp):
+                local = read_events(jp, env=env)
+        shipped = None
+        if shipped_by_source:
+            from maggy_tpu.telemetry.sink import sanitize_source
+
+            shipped = shipped_by_source.get(sanitize_source(name))
+        gp: Dict[str, Any] = {}
+        if shipped is not None and local is not None:
+            from maggy_tpu.telemetry.sink import merge_source_events
+
+            gp = compute_goodput(merge_source_events(shipped, local))
+        elif local is not None or shipped is not None:
+            gp = compute_goodput(local if local is not None else shipped)
+        tenants[name] = {"chip_seconds": oe["runner_seconds"],
+                         "goodput": gp}
+        held = gp.get("held_chip_s") or 0.0
+        frac = gp.get("goodput_fraction")
+        if held > 0 and frac is not None:
+            fleet_held += held
+            fleet_train += held * frac
+    goodput_block: Dict[str, Any] = {
+        "tenants": tenants,
+        "chip_seconds": round(
+            sum(t["chip_seconds"] or 0.0 for t in tenants.values()), 3),
+        # Held-time-weighted fleet goodput across every tenant that had
+        # a foldable journal (None when none did).
+        "goodput_fraction": round(fleet_train / fleet_held, 4)
+        if fleet_held > 0 else None,
+    }
+
     # Fair-share check over the overlap window: the span in which EVERY
     # leased experiment had started leasing and none had fully finished —
     # outside it, a lone experiment legitimately takes the whole fleet.
@@ -1667,6 +1764,10 @@ def replay_fleet_journal(path: str, env=None,
         # Last reported clock offset per agent — the unified trace's
         # cross-process time base.
         "clock_offsets": clock_offsets,
+        # Per-tenant chip-time ledger: lease-granted chip-seconds plus
+        # each tenant's own journal fold (``python -m
+        # maggy_tpu.telemetry goodput <fleet home>`` prints this).
+        "goodput": goodput_block,
         "share": share,
         "expected_share": expected,
         "share_error": share_error,

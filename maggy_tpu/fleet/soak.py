@@ -156,6 +156,9 @@ def run_fleet_soak(runners: int = 2, bulk_trials: int = 6,
         "max_queue_wait_s": replay["max_queue_wait_s"],
         "resumed_from_steps": sorted(resumed_from),
         "experiments": replay["experiments"],
+        # Per-tenant chip-time ledger roll-up (lease-derived
+        # chip-seconds + each tenant's own journal fold).
+        "goodput": replay.get("goodput"),
         "wall_s": round(wall_s, 2),
     }
     return {"ok": not violations, "violations": violations,

@@ -19,6 +19,9 @@ counts, replayed from status.json + fleet.jsonl.
 
 ``--telem`` polls the TELEM verb instead: the driver's live telemetry
 snapshot (trial-span scheduling numbers + RPC service-time histograms).
+``--goodput`` renders the chip-time goodput ledger over the same verb:
+the experiment's goodput fraction, top badput buckets, and per-partition
+held-time split (telemetry/goodput.py; docs/telemetry.md).
 ``--health`` renders the live health view over the same verb: the health
 engine's straggler/hang/RTT flags plus per-partition runner stats (step
 cadence, time-to-first-metric, heartbeat RTT, RSS) — see
@@ -249,6 +252,20 @@ def render_health(snap: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_goodput_view(snap: Dict[str, Any]) -> str:
+    """Multi-line view of the TELEM snapshot's goodput ledger: the
+    fleet's goodput fraction, the top badput buckets, and each
+    partition's held-time split (telemetry/goodput.py)."""
+    if snap.get("type") == "ERR":
+        return "telemetry: {}".format(snap.get("error"))
+    if not snap.get("enabled", True):
+        return "telemetry: disabled for this experiment"
+    from maggy_tpu.telemetry.goodput import render_goodput
+
+    block = (snap.get("spans") or {}).get("goodput") or {}
+    return "\n".join(render_goodput(block))
+
+
 def render_live(status: Dict[str, Any], healthz_code: int,
                 healthz: Dict[str, Any]) -> str:
     """Multi-line view of one obs /status + /healthz scrape: a header
@@ -448,6 +465,11 @@ def main(argv=None) -> int:
                         "health engine plus per-partition runner stats "
                         "(step cadence, time-to-first-metric, heartbeat "
                         "RTT, RSS)")
+    p.add_argument("--goodput", action="store_true",
+                   help="poll the TELEM verb and render the chip-time "
+                        "goodput ledger: the experiment's goodput "
+                        "fraction, top badput buckets (compile, rework, "
+                        "idle, ...), and per-partition held-time split")
     p.add_argument("--live", metavar="HOST:PORT",
                    help="watch via the observability plane instead of the "
                         "RPC verbs: scrape GET /status + /healthz from a "
@@ -461,13 +483,15 @@ def main(argv=None) -> int:
                         "status.json + fleet.jsonl (no RPC — works after "
                         "the fleet exits too)")
     args = p.parse_args(argv)
-    if (args.telem or args.health) and args.logs:
+    if (args.telem or args.health or args.goodput) and args.logs:
         p.error("--logs streams over the LOG verb; run it without "
-                "--telem/--health (or use two monitor processes)")
+                "--telem/--health/--goodput (or use two monitor "
+                "processes)")
     if args.live:
-        if args.telem or args.health or args.logs or args.fleet:
+        if args.telem or args.health or args.logs or args.fleet \
+                or args.goodput:
             p.error("--live scrapes the obs HTTP endpoints; drop "
-                    "--telem/--health/--logs/--fleet")
+                    "--telem/--health/--logs/--fleet/--goodput")
         polled_ok = False
         failures = 0
         last = None
@@ -495,8 +519,9 @@ def main(argv=None) -> int:
                 return 0
             time.sleep(args.interval)
     if args.fleet:
-        if args.telem or args.health or args.logs:
-            p.error("--fleet is file-based; drop --telem/--health/--logs")
+        if args.telem or args.health or args.logs or args.goodput:
+            p.error("--fleet is file-based; drop "
+                    "--telem/--health/--logs/--goodput")
         last = None
         while True:
             status, replay = _poll_fleet(args.fleet)
@@ -532,7 +557,8 @@ def main(argv=None) -> int:
     logs_seen = 0
     while True:
         try:
-            snap = (poll_telemetry if (args.telem or args.health)
+            snap = (poll_telemetry
+                    if (args.telem or args.health or args.goodput)
                     else poll_progress)(addr, secret)
         except (ConnectionError, socket.timeout, OSError) as e:
             if not polled_ok:
@@ -551,6 +577,8 @@ def main(argv=None) -> int:
         polled_ok = True
         if args.health:
             print(render_health(snap), flush=True)
+        elif args.goodput:
+            print(render_goodput_view(snap), flush=True)
         else:
             print(render_telem(snap) if args.telem else render(snap),
                   flush=True)

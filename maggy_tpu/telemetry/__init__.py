@@ -151,6 +151,7 @@ class Telemetry:
         stats = dict(stats)
         skipped = stats.pop("profile_skipped", None) or []
         compile_events = stats.pop("compile_events", None) or []
+        ckpt_events = stats.pop("ckpt_events", None) or []
         if stats:
             with self._runner_lock:
                 merged = self._runner_state.setdefault(pid, {})
@@ -192,6 +193,18 @@ class Telemetry:
                 self.metrics.counter(
                     "compile.warm_hits" if record.get("warm")
                     else "compile.warm_misses").inc()
+        for record in ckpt_events:
+            # The runner's checkpoint I/O totals (save_ms/restore_ms/
+            # saves/restores) journaled as the trial's ``ckpt_saved``
+            # span phase — once per span, same re-delivery dedup as
+            # ``compiled``. The goodput ledger's ckpt_save/ckpt_restore
+            # buckets fold from exactly this record.
+            record = dict(record)
+            trial_id = record.pop("trial", None)
+            if not trial_id:
+                continue
+            self.trial_event(trial_id, "ckpt_saved", partition=pid,
+                             once=True, **record)
 
     def prune_partition(self, partition) -> None:
         """Forget a dead/replaced partition's live state: its
@@ -255,6 +268,33 @@ class Telemetry:
         derived = derive(self.events())
         self._derive_cache = (now, n, derived)
         return derived
+
+    def refresh_goodput_gauges(self) -> Dict[str, Any]:
+        """Fold the journal's goodput ledger (via the ~1 Hz derive cache)
+        into live registry gauges — ``goodput.fraction``, ``goodput.
+        unaccounted_fraction``, ``goodput.held_chip_s`` and per-partition
+        ``goodput.fraction.p<pid>`` — so a /metrics scrape (and the
+        fleet's federated exposition) carries the current ledger without
+        a second fold path. Returns the ledger block. The obs server
+        calls this just before rendering an exposition; anything else
+        reading the gauges gets at-most-a-second-stale numbers."""
+        if not self.enabled:
+            return {}
+        block = self._derived_spans().get("goodput") or {}
+        if not block:
+            return block
+        self.metrics.gauge("goodput.fraction").set(
+            block.get("goodput_fraction") or 0.0)
+        self.metrics.gauge("goodput.unaccounted_fraction").set(
+            block.get("unaccounted_fraction") or 0.0)
+        self.metrics.gauge("goodput.held_chip_s").set(
+            round(block.get("held_chip_s") or 0.0, 3))
+        for pid, p in (block.get("per_partition") or {}).items():
+            if p.get("goodput_fraction") is not None:
+                self.metrics.gauge(
+                    "goodput.fraction.p{}".format(pid)).set(
+                    p["goodput_fraction"])
+        return block
 
     def snapshot(self, fresh: bool = False) -> Dict[str, Any]:
         """Plain-dict snapshot: live metrics + span-derived scheduling

@@ -2,6 +2,7 @@
 
     trace <exp_dir|journal.jsonl> [-o OUT]   journal -> Perfetto JSON
     replay <exp_dir|journal.jsonl>           journal -> derived numbers
+    goodput <exp_dir|journal.jsonl|fleet home>  chip-time ledger
 
 ``trace`` writes Chrome-trace-event JSON loadable in https://ui.perfetto.dev
 or chrome://tracing (one track per partition, trial slices with phase
@@ -52,7 +53,19 @@ def main(argv=None) -> int:
     pr = sub.add_parser("replay", help="print journal-derived scheduling "
                                        "numbers as JSON")
     pr.add_argument("path", help="experiment dir or telemetry.jsonl path")
+    pg = sub.add_parser("goodput",
+                        help="print the chip-time goodput ledger (where "
+                             "every held chip-second went); a fleet home "
+                             "dir rolls up per tenant")
+    pg.add_argument("path", help="experiment dir, telemetry.jsonl path, "
+                                 "or a fleet home dir (fleet.jsonl)")
+    pg.add_argument("--json", action="store_true",
+                    help="emit the full ledger as JSON instead of the "
+                         "human summary")
     args = p.parse_args(argv)
+
+    if args.command == "goodput":
+        return _goodput(args)
 
     # A fleet home dir (fleet.jsonl present) renders the multiplexed
     # timeline: one track per fleet RUNNER with a lane per experiment,
@@ -81,6 +94,40 @@ def main(argv=None) -> int:
         msg += " ({} torn line(s) skipped)".format(torn)
     print(msg)
     print("open in https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+def _goodput(args) -> int:
+    """The chip-time ledger, offline. An experiment dir/journal folds
+    directly; a fleet home dir (fleet.jsonl present) prints the fleet
+    replay's per-tenant roll-up — lease-derived chip-seconds plus each
+    tenant's own journal fold, clock-offset-corrected."""
+    import json as _json
+
+    from maggy_tpu.telemetry.goodput import compute_goodput, render_goodput
+
+    if os.path.isdir(args.path) and \
+            os.path.exists(os.path.join(args.path, "fleet.jsonl")):
+        from maggy_tpu.fleet.scheduler import replay_fleet_journal
+
+        replay = replay_fleet_journal(args.path)
+        block = replay.get("goodput") or {}
+        if args.json:
+            print(_json.dumps(block, indent=2, default=str))
+            return 0
+        for tenant, tb in sorted((block.get("tenants") or {}).items()):
+            print("tenant {}: {:.1f} leased chip-seconds".format(
+                tenant, tb.get("chip_seconds") or 0.0))
+            for line in render_goodput(tb.get("goodput") or {}):
+                print("  " + line)
+        return 0
+    events = read_events(_resolve_journal(args.path))
+    block = compute_goodput(events)
+    if args.json:
+        print(_json.dumps(block, indent=2, default=str))
+        return 0
+    for line in render_goodput(block):
+        print(line)
     return 0
 
 

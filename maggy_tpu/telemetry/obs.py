@@ -98,6 +98,17 @@ def _split_family(name: str) -> Tuple[str, Dict[str, str]]:
         return "rpc_handle_ms", {"verb": name[len("rpc.handle_ms."):]}
     if name.startswith("trial.phase."):
         return "trial_phase_total", {"phase": name[len("trial.phase."):]}
+    if name.startswith("goodput.fraction.p") \
+            and name[len("goodput.fraction.p"):].isdigit():
+        # goodput.fraction.p<pid> gauges (Telemetry.refresh_goodput_
+        # gauges) -> one labeled family, like the runner gauges.
+        return "goodput_fraction", \
+            {"partition": name[len("goodput.fraction.p"):]}
+    if name.startswith("tenant.chip_seconds."):
+        # Fleet scheduler per-tenant chip-second totals -> one family
+        # labeled by tenant experiment (the autoscaler-ready signal).
+        return "tenant_chip_seconds", \
+            {"tenant": name[len("tenant.chip_seconds."):]}
     return _sanitize(name), {}
 
 
@@ -229,6 +240,13 @@ class ObsServer:
         snaps = []
         for reg in self.registrations():
             try:
+                # Pre-scrape hook: fold the goodput ledger into gauges so
+                # the exposition carries the CURRENT chip-time accounting
+                # (the registry is otherwise only written on events).
+                refresh = getattr(reg.telemetry,
+                                  "refresh_goodput_gauges", None)
+                if refresh is not None:
+                    refresh()
                 snaps.append((reg.labels,
                               reg.telemetry.metrics.snapshot()))
             except Exception:  # noqa: BLE001 - one experiment must not break the scrape
@@ -246,6 +264,17 @@ class ObsServer:
             doc: Dict[str, Any] = {"labels": reg.labels}
             try:
                 doc["telem"] = reg.telemetry.snapshot()
+                # Operator headline: the ledger's roll-up hoisted out of
+                # the full spans block (which carries the detail).
+                gp = (doc["telem"].get("spans") or {}).get("goodput") or {}
+                if gp:
+                    doc["goodput"] = {
+                        "fraction": gp.get("goodput_fraction"),
+                        "unaccounted_fraction":
+                            gp.get("unaccounted_fraction"),
+                        "held_chip_s": round(
+                            gp.get("held_chip_s") or 0.0, 1),
+                        "badput_top": gp.get("badput_top") or []}
             except Exception as e:  # noqa: BLE001 - scrape must degrade, not die
                 doc["telem"] = {"error": repr(e)}
             if reg.status_fn is not None:

@@ -136,6 +136,14 @@ class RunnerStats:
         self._compile_final = False  # guarded-by: _lock
         self._ttfm_accounted: Optional[float] = None  # guarded-by: _lock
         self._compile_events: List[Dict[str, Any]] = []  # guarded-by: _lock
+        # Checkpoint I/O attribution for the CURRENT trial (merged by
+        # note_ckpt; save_ms/restore_ms accumulate across the trial's
+        # saves/restores) and the finished records awaiting shipment —
+        # the goodput ledger's ckpt_save/ckpt_restore buckets fold from
+        # the journaled "ckpt_saved" span phase this becomes.
+        self._ckpt: Dict[str, Any] = {}  # guarded-by: _lock
+        self._ckpt_final = False  # guarded-by: _lock
+        self._ckpt_events: List[Dict[str, Any]] = []  # guarded-by: _lock
         # Cumulative warm-slot / compilation-cache counters for THIS
         # runner (train/warm.py routes them here through the trial scope).
         self._counters: Dict[str, int] = {}  # guarded-by: _lock
@@ -153,6 +161,8 @@ class RunnerStats:
             self._compile = {}
             self._compile_final = False
             self._ttfm_accounted = None
+            self._ckpt = {}
+            self._ckpt_final = False
 
     def trial_end(self, trial_id: Optional[str] = None) -> None:
         with self._lock:
@@ -164,6 +174,7 @@ class RunnerStats:
             # A trial that never broadcast (errored / metric-free) ships
             # too — without the ttfm-derived first_step_ms residual.
             self._finalize_compile_locked()
+            self._finalize_ckpt_locked()
             self._trials_done += 1
             self._trial_id = None
             self._trial_t0 = None
@@ -186,6 +197,31 @@ class RunnerStats:
                 record[k] = round(record[k], 1)
         self._compile_events.append(record)
         self._compile_final = True
+
+    # locked-by: _lock
+    def _finalize_ckpt_locked(self) -> None:
+        if self._ckpt_final or not self._ckpt:
+            return
+        record = dict(self._ckpt)
+        record["trial"] = self._trial_id
+        for k in ("save_ms", "restore_ms"):
+            if k in record:
+                record[k] = round(record[k], 1)
+        self._ckpt_events.append(record)
+        self._ckpt_final = True
+
+    def note_ckpt(self, **fields: Any) -> None:
+        """Merge checkpoint I/O attribution for the current trial.
+        ``*_ms`` fields and the ``saves``/``restores`` counts ACCUMULATE
+        (a trial checkpoints many times); others are first-write-wins."""
+        with self._lock:
+            for k, v in fields.items():
+                if k.endswith("_ms"):
+                    self._ckpt[k] = self._ckpt.get(k, 0.0) + float(v)
+                elif k in ("saves", "restores"):
+                    self._ckpt[k] = int(self._ckpt.get(k, 0)) + int(v)
+                else:
+                    self._ckpt.setdefault(k, v)
 
     def note_compile(self, **fields: Any) -> None:
         """Merge compile-phase attribution for the current trial.
@@ -301,6 +337,9 @@ class RunnerStats:
             if self._compile_events:
                 delta["compile_events"] = self._compile_events
                 self._compile_events = []
+            if self._ckpt_events:
+                delta["ckpt_events"] = self._ckpt_events
+                self._ckpt_events = []
         return delta
 
     def requeue_delta(self, delta: Dict[str, Any]) -> None:
@@ -313,7 +352,10 @@ class RunnerStats:
             self._profile_skipped = list(skipped) + self._profile_skipped
             events = delta.get("compile_events") or []
             self._compile_events = list(events) + self._compile_events
+            ckpts = delta.get("ckpt_events") or []
+            self._ckpt_events = list(ckpts) + self._ckpt_events
             for k, v in delta.items():
-                if k not in ("profile_skipped", "compile_events") \
+                if k not in ("profile_skipped", "compile_events",
+                             "ckpt_events") \
                         and self._last_shipped.get(k) == v:
                     del self._last_shipped[k]

@@ -198,8 +198,16 @@ def derive(events: List[Dict[str, Any]],
       (``steps_saved``), fork-load (checkpoint staging) latency p50/p95,
       downgrades (``fork_source_lost``) and ``ckpt_gc`` retirements.
       Empty for non-forking journals.
+    - ``goodput``: the chip-time ledger (telemetry/goodput.py) — every
+      held runner-second classified into the closed GOODPUT_BUCKETS
+      taxonomy (train vs init/trace/compile/ckpt/fork_stage/rework/
+      handoff/queue_wait/idle/unaccounted), per-partition and per-trial,
+      gang-aware. Empty for journals with no runner activity.
     - ``trials``: lifecycle counts.
     """
+    # Lazy import: goodput.py imports HANDOFF_CAP_S from this module at
+    # top level, so the cycle is broken here, not there.
+    from maggy_tpu.telemetry.goodput import compute_goodput
     by_partition: Dict[int, List[tuple]] = {}
     stop_flagged: Dict[str, float] = {}
     finalized_at: Dict[str, float] = {}
@@ -414,4 +422,5 @@ def derive(events: List[Dict[str, Any]],
         "preempt": preempt,
         "compile": compile_block,
         "fork": fork_block,
+        "goodput": compute_goodput(events, handoff_cap_s=handoff_cap_s),
     }

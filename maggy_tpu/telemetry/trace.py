@@ -204,6 +204,19 @@ def build_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     # tracks exist because a gang band lands on them.
     partitions |= gang_parts
 
+    # Per-partition goodput-fraction counter tracks: the chip-time
+    # ledger's cumulative train/held fraction sampled at each attempt
+    # end (telemetry/goodput.py) — utilization drift is a visible line
+    # under each partition's track, next to its rss/RTT counters.
+    from maggy_tpu.telemetry.goodput import compute_goodput
+
+    gp = compute_goodput(events)
+    for p, pts in (gp.get("partition_samples") or {}).items():
+        for t, frac in pts:
+            out.append({"name": "goodput_fraction", "cat": "goodput",
+                        "ph": "C", "ts": us(t), "pid": _pid(int(p)),
+                        "args": {"goodput_fraction": frac}})
+
     # Track naming metadata: driver + one process per partition, sorted so
     # Perfetto lists partition 0..N in order.
     meta = [{"name": "process_name", "ph": "M", "pid": DRIVER_PID, "tid": 0,

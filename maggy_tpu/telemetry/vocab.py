@@ -41,6 +41,12 @@ SPAN_PHASES = (
     # parent→child Perfetto flow arrow and derive()'s fork block counts
     # steps_saved from.
     "forked_from",
+    # Runner-measured checkpoint I/O totals for one trial, shipped once
+    # at trial end through the heartbeat stats channel (mirrors
+    # "compiled"). Fields: save_ms, restore_ms, saves, restores,
+    # partition. The goodput ledger's ckpt_save / ckpt_restore badput
+    # buckets fold from this record.
+    "ckpt_saved",
 )
 
 #: Top-level journal event kinds (the ``ev`` field).
@@ -194,6 +200,27 @@ CHAOS_KINDS = frozenset({
     "kill_fork",
 })
 
+#: The goodput ledger's closed chip-time taxonomy (telemetry/goodput.py):
+#: every held runner-second folds into exactly one bucket. ``train`` is
+#: goodput; everything else is badput; ``unaccounted`` is the explicit
+#: residual the bench gate bounds (never silently absorbed into another
+#: bucket). Order is the canonical reporting order.
+GOODPUT_BUCKETS = (
+    "train",          # inside train_fn, productive (first-run) steps
+    "init",           # sharded state init (compiled record init_ms)
+    "trace",          # jaxpr trace (compiled record trace_ms)
+    "compile",        # XLA compile (compiled record compile_ms)
+    "ckpt_save",      # checkpoint writes (ckpt_saved record save_ms)
+    "ckpt_restore",   # checkpoint reads (ckpt_saved record restore_ms)
+    "fork_stage",     # parent-checkpoint staging (fork_load_ms)
+    "rework",         # re-trained work: dead attempts + from-scratch
+                      #   promotions re-running the parent prefix
+    "handoff",        # FINAL -> next running gap (< HANDOFF_CAP_S)
+    "queue_wait",     # runner registered -> first trial running
+    "idle",           # reserved but trial-less (rung barriers, drain)
+    "unaccounted",    # residual the accounting could not attribute
+)
+
 #: Health-engine event fields (``ev: "health"``).
 HEALTH_STATUSES = frozenset({"raised", "cleared", "started", "error"})
 HEALTH_CHECKS = frozenset({"engine", "straggler", "hb_rtt", "hang"})
@@ -207,6 +234,7 @@ ALL_REASONS = REQUEUE_REASONS | LEASE_END_REASONS | PROFILE_REASONS
 
 __all__ = [
     "SPAN_PHASES", "EVENT_KINDS", "REQUEUE_REASONS", "PROFILE_REASONS",
+    "GOODPUT_BUCKETS",
     "EXPERIMENT_PHASES", "RUNNER_PHASES", "WORKER_PHASES",
     "FLEET_PHASES", "FLEET_EXPERIMENT_PHASES", "LEASE_PHASES",
     "LEASE_END_REASONS", "AGENT_PHASES", "CHAOS_KINDS",

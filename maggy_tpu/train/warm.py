@@ -156,6 +156,17 @@ def note_compile(**fields: Any) -> None:
         stats.note_compile(**fields)
 
 
+def note_ckpt(**fields: Any) -> None:
+    """Record checkpoint I/O telemetry for the current trial (merged into
+    its RunnerStats ``ckpt`` record; ``*_ms`` and ``saves``/``restores``
+    accumulate). No-op outside a trial scope — library users running
+    checkpointing outside an experiment pay nothing."""
+    scope = current_scope()
+    stats = scope.stats if scope is not None else None
+    if stats is not None:
+        stats.note_ckpt(**fields)
+
+
 # ----------------------------------------------------------------- counters
 
 def _count(key: str, n: int = 1) -> None:

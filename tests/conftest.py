@@ -103,6 +103,16 @@ def pytest_configure(config):
         "degrade/re-ship exactly-once seam (invariant 12), clock-offset "
         "estimation, metrics federation, and the unified Perfetto "
         "trace. Select with -m sink.")
+    config.addinivalue_line(
+        "markers",
+        "goodput: chip-time goodput ledger tests (maggy_tpu.telemetry."
+        "goodput) — the offline journal fold (closed bucket taxonomy, "
+        "exact closure, gang chip-multiplication, rotation/failover "
+        "seams), clock-offset-corrected merges, rework attribution "
+        "(chaos invariant 15), and the per-tenant fleet roll-up. The "
+        "A/B gate is `bench.py --goodput`; the fault-free control soak "
+        "is `python -m maggy_tpu.chaos --goodput`. Select with "
+        "-m goodput.")
 
 
 @pytest.fixture(autouse=True)

@@ -450,12 +450,12 @@ class TestTelemSnapshotSchema:
         telem = Telemetry(enabled=True)
         telem.trial_event("t1", "queued")
         spans = telem.snapshot(fresh=True)["spans"]
-        # The derive() contract incl. the PR-5 preempt block and the
-        # checkpoint-forking fork block; dist blocks are {} or
-        # {median_ms, p95_ms, n}.
+        # The derive() contract incl. the PR-5 preempt block, the
+        # checkpoint-forking fork block, and the chip-time goodput
+        # ledger; dist blocks are {} or {median_ms, p95_ms, n}.
         assert set(spans) == {"trials", "handoff", "early_stop_reaction",
                               "requeue_recovery", "suggest", "preempt",
-                              "compile", "fork"}
+                              "compile", "fork", "goodput"}
         assert set(spans["trials"]) == {"created", "finalized",
                                         "early_stopped", "errors", "lost",
                                         "requeued"}

@@ -101,9 +101,14 @@ class TestBuildTrace:
     def test_counter_events_from_runner_stats(self):
         trace = build_trace(_journal())
         counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
-        assert {c["name"] for c in counters} == {"rss_mb", "hb_rtt_ms"}
+        # rss/hb_rtt ride runner_stats samples; goodput_fraction is the
+        # per-partition chip-time ledger track (telemetry/goodput.py).
+        assert {c["name"] for c in counters} == \
+            {"rss_mb", "hb_rtt_ms", "goodput_fraction"}
         rss = next(c for c in counters if c["name"] == "rss_mb")
         assert rss["pid"] == 0 + 1 and rss["args"]["rss_mb"] == 120.5
+        gp = next(c for c in counters if c["name"] == "goodput_fraction")
+        assert 0.0 <= gp["args"]["goodput_fraction"] <= 1.0
 
     def test_events_without_partition_land_on_driver_track(self):
         trace = build_trace(_journal())
