@@ -104,6 +104,66 @@ def train_mnist(lr, batch=256, budget=1, reporter=None):
     return {"metric": -float(loss)}
 
 
+# --vmap micro-trial knobs: the trial body must DOMINATE the per-trial
+# control-plane cost (dir mint, journal edges, FINAL round-trip) or the
+# block's K-for-one dispatch saving drowns in fixed overhead and the
+# speedup gate measures the scheduler, not the engine.
+VMAP_STEPS = int(os.environ.get("BENCH_VMAP_STEPS", "2500"))
+VMAP_BATCH = int(os.environ.get("BENCH_VMAP_BATCH", "256"))
+
+
+def train_mnist_vmap(lr, lanes=None, reporter=None):
+    """Micro-trial for the --vmap gate: a tiny MnistMLP (matmul +
+    elementwise only — the model family the bitwise lane-parity property
+    is pinned on) trained full-batch for VMAP_STEPS. Lanes-capable: under
+    ``config.vmap_lanes`` > 1 the executor hands a `LaneSet` and the K
+    configs train as ONE vmapped program; with ``lanes=None`` (scalar
+    dispatch, and the warm-up trial every runner's first dispatch always
+    is) it degrades to the plain Trainer path."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from maggy_tpu.models import MnistMLP
+    from maggy_tpu.parallel import make_mesh
+    from maggy_tpu.train import Trainer, VmapTrainer, swept_transform
+
+    mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+    model = MnistMLP(features=8, num_classes=2)
+    batch = {"inputs": (jnp.asarray(DATA_X[:VMAP_BATCH]),),
+             "labels": jnp.asarray(DATA_Y[:VMAP_BATCH])}
+    rng = jax.random.key(0)
+    if lanes is None:
+        trainer = Trainer(
+            model, swept_transform(optax.adam, learning_rate=lr),
+            _bench_loss, mesh, strategy="dp")
+        trainer.init(rng, (batch["inputs"][0][:1],))
+        loss = None
+        for i in range(VMAP_STEPS):
+            loss = trainer.step(trainer.place_batch(batch))
+            if reporter is not None and i % 100 == 0:
+                reporter.broadcast(-loss, step=i)
+        return {"metric": -float(loss)}
+    # Vectorized block: one AOT executable trains every lane in lockstep.
+    # The raw (unplaced) batch is broadcast across lanes by the trainer
+    # (in_axes=None on the batch leaf).
+    vt = VmapTrainer(
+        model, optax.adam,
+        [{"learning_rate": h["lr"]} for h in lanes.hparams],
+        _bench_loss, mesh, strategy="dp")
+    vt.init(rng, (batch["inputs"][0][:1],))
+    losses = None
+    for i in range(VMAP_STEPS):
+        losses = vt.step(batch)
+        if i % 100 == 0:
+            reporter.broadcast_lanes(-jnp.asarray(losses), step=i)
+            for li in lanes.take_stopped():
+                lanes.retire(li, -float(np.asarray(losses)[li]))
+    final = np.asarray(losses)
+    return {tid: -float(final[i])
+            for i, tid in enumerate(lanes.trial_ids)}
+
+
 def run_framework_sweep(num_trials=None, workers=3):
     if num_trials is None:
         num_trials = int(os.environ.get("BENCH_NUM_TRIALS", "18"))
@@ -581,15 +641,37 @@ def _force_cpu_if_requested():
             pass
 
 
+def _pin_bench_env(cpu=False, fake_devices=None):
+    """Shared prologue for every bench child/gate: mint the shared base
+    dir once (NOT setdefault(k, mkdtemp()) — the fallback arg evaluates
+    eagerly, so every child spawned by the orchestrator, which already
+    exported the shared base dir, would mint and abandon an empty
+    /tmp/bench_* dir), and for the CPU-pinned A/B gates pin the platform
+    BEFORE any jax import: the JAX_PLATFORMS env var, the
+    accelerator-bootstrap scrub (a TPU-plugin sitecustomize must not
+    dial the tunnel at child interpreter startup), and the live-config
+    force. ``fake_devices`` adds the
+    xla_force_host_platform_device_count flag for soaks whose topology
+    is N fake host devices."""
+    if "MAGGY_TPU_BASE_DIR" not in os.environ:
+        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
+    if cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        for var in _ACCEL_BOOTSTRAP_VARS:
+            os.environ.pop(var, None)
+    if fake_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count={}"
+                .format(fake_devices)).strip()
+    _force_cpu_if_requested()
+
+
 def headline_main():
     """Child process: warm-up, framework sweep, stage-based baselines.
     Prints the headline JSON line (no extras) on stdout."""
-    # NOT setdefault(k, mkdtemp()): the fallback arg evaluates eagerly, so
-    # every child spawned by the orchestrator (which already exported the
-    # shared base dir) would mint and abandon an empty /tmp/bench_* dir.
-    if "MAGGY_TPU_BASE_DIR" not in os.environ:
-        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
-    _force_cpu_if_requested()
+    _pin_bench_env()
     from maggy_tpu.util import enable_compile_cache
 
     enable_compile_cache()
@@ -754,9 +836,7 @@ def chaos_main():
     local sweep and prints one JSON line with the invariant verdict and
     the fault->requeue recovery latencies replayed from the telemetry
     journal. Exit 1 if any recovery invariant is violated."""
-    if "MAGGY_TPU_BASE_DIR" not in os.environ:
-        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
-    _force_cpu_if_requested()
+    _pin_bench_env()
     from maggy_tpu.chaos.harness import run_soak
 
     seed = int(os.environ.get("BENCH_CHAOS_SEED", "7"))
@@ -875,9 +955,7 @@ def failover_main():
     under the bound, and (c) replayed-vs-live parity: the recovered
     sweep's final trial-id set must be IDENTICAL to an uninterrupted run
     of the same seeded schedule. Exit 1 on any violation."""
-    if "MAGGY_TPU_BASE_DIR" not in os.environ:
-        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
-    _force_cpu_if_requested()
+    _pin_bench_env()
     from maggy_tpu.chaos.driver_soak import run_driver_soak
 
     seed = int(os.environ.get("BENCH_FAILOVER_SEED", "7"))
@@ -989,12 +1067,7 @@ def fork_main():
     Always CPU-pinned (closed-form trial body; the fake accelerator adds
     nothing) with detail.platform recorded per the ROADMAP flaky-TPU
     comparability note. Exit 1 on any gate failure."""
-    if "MAGGY_TPU_BASE_DIR" not in os.environ:
-        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    for var in _ACCEL_BOOTSTRAP_VARS:
-        os.environ.pop(var, None)
-    _force_cpu_if_requested()
+    _pin_bench_env(cpu=True)
     import glob as _glob
 
     from maggy_tpu import OptimizationConfig, Searchspace, experiment
@@ -1188,6 +1261,195 @@ def fork_main():
     return 0 if ok else 1
 
 
+def _vmap_lane_parity(steps=25):
+    """Engine-level bitwise sub-gate for --vmap (idiom shared with
+    tests/test_vmap.py): K scalar Trainer runs vs one VmapTrainer block
+    over the SAME configs must agree bit-for-bit per lane, per step —
+    MnistMLP is matmul+elementwise only, so XLA's scalar and vmapped
+    programs schedule the same float ops in the same order. Returns a
+    violations list (empty = parity holds)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from maggy_tpu.models import MnistMLP
+    from maggy_tpu.parallel import make_mesh
+    from maggy_tpu.train import (Trainer, VmapTrainer, clear_warm,
+                                 swept_transform)
+
+    mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+    model = MnistMLP(features=8, num_classes=2)
+    X = DATA_X[:128]
+    batch = {"inputs": (jnp.asarray(X),),
+             "labels": jnp.asarray(DATA_Y[:128])}
+    rng = jax.random.key(0)
+    lrs = [1e-3, 3e-3, 1e-2, 3e-2]
+
+    def scalar_run(lr):
+        tr = Trainer(model, swept_transform(optax.adam, learning_rate=lr),
+                     _bench_loss, mesh, strategy="dp")
+        tr.init(rng, (batch["inputs"][0][:1],))
+        return np.asarray([float(tr.step(tr.place_batch(batch)))
+                           for _ in range(steps)])
+
+    clear_warm()
+    scalar = {lr: scalar_run(lr) for lr in lrs}
+    clear_warm()
+    vt = VmapTrainer(model, optax.adam,
+                     [{"learning_rate": lr} for lr in lrs],
+                     _bench_loss, mesh, strategy="dp")
+    vt.init(rng, (batch["inputs"][0][:1],))
+    vlosses = np.stack([np.asarray(vt.step(batch)) for _ in range(steps)])
+    clear_warm()
+    violations = []
+    for i, lr in enumerate(lrs):
+        if not np.array_equal(scalar[lr], vlosses[:, i]):
+            d = int(np.argmax(scalar[lr] != vlosses[:, i]))
+            violations.append(
+                "lane {} (lr={}) diverges from its scalar run at step {}: "
+                "{!r} vs {!r}".format(i, lr, d, scalar[lr][d],
+                                      vlosses[d, i]))
+    return violations
+
+
+def vmap_main():
+    """``bench.py --vmap``: the vectorized micro-trials gate (ROADMAP
+    item 4). THREE arms of the SAME seeded random-search micro-sweep on
+    ONE pinned platform:
+
+      scalar — vmap_lanes unset (the default 1): one trial per dispatch;
+      lanes1 — vmap_lanes=1 explicitly: must journal-replay to the
+               IDENTICAL schedule as scalar (the bit-for-bit
+               compatibility contract of the default);
+      vmap   — vmap_lanes=K: the driver assembles K program-compatible
+               suggestions into blocks, each block one vmapped program.
+
+    Gates: (a) trials/hour ratio wall_scalar / wall_vmap >= 5 (the
+    micro-trial regime is dispatch-overhead-dominated, so K lanes per
+    program approaches Kx even on CPU); (b) engine-level bitwise
+    per-lane parity vs scalar runs (`_vmap_lane_parity`); (c) scalar vs
+    lanes1 finalized-schedule parity via `journal_schedule_parity` with
+    per-arm platform stamps; (d) the vmap arm actually assembled blocks
+    (lane-tagged journal edges — a silently-scalar run must not pass).
+
+    Always CPU-pinned (CPU-proxy per the ROADMAP flaky-TPU note) with
+    detail.platform stamped. Exit 1 on any gate failure."""
+    _pin_bench_env(cpu=True)
+    import glob as _glob
+
+    from maggy_tpu import OptimizationConfig, Searchspace, experiment
+    from maggy_tpu.telemetry import JOURNAL_NAME, read_events, replay_journal
+
+    seed = int(os.environ.get("BENCH_VMAP_SEED", "7"))
+    trials = int(os.environ.get("BENCH_VMAP_TRIALS", "25"))
+    lanes_k = int(os.environ.get("BENCH_VMAP_LANES", "8"))
+    need = float(os.environ.get("BENCH_VMAP_SPEEDUP", "5"))
+    t_start = time.time()
+    arms = {}
+    for arm, k in (("scalar", None), ("lanes1", 1), ("vmap", lanes_k)):
+        arm_dir = os.path.join(os.environ["MAGGY_TPU_BASE_DIR"],
+                               "vmap_ab_{}".format(arm))
+        config = OptimizationConfig(
+            name="bench_vmap_{}".format(arm), num_trials=trials,
+            optimizer="randomsearch",
+            searchspace=Searchspace(lr=("DOUBLE_LOG", [1e-3, 3e-2])),
+            direction="max", num_workers=1, hb_interval=0.05,
+            es_policy="none", seed=seed, experiment_dir=arm_dir,
+            **({"vmap_lanes": k} if k is not None else {}))
+        t0 = time.time()
+        experiment.lagom(train_mnist_vmap, config)
+        wall = time.time() - t0
+        exp_dir = sorted(d for d in _glob.glob(os.path.join(arm_dir, "*"))
+                         if os.path.isdir(d))[-1]
+        events = read_events(os.path.join(exp_dir, JOURNAL_NAME))
+        arms[arm] = {
+            "wall_s": round(wall, 2), "events": events,
+            "derived": replay_journal(os.path.join(exp_dir, JOURNAL_NAME)),
+            "platform": _current_platform(),
+        }
+        n_lane = len([e for e in events if e.get("phase") == "assigned"
+                      and e.get("lane") is not None])
+        log("{} arm: {} trials in {:.1f}s ({} lane-tagged assignments)"
+            .format(arm, trials, wall, n_lane))
+
+    violations = []
+
+    # (a) throughput: K lanes per program must beat scalar dispatch by
+    # the gate factor in the dispatch-bound micro-trial regime.
+    speedup = round(arms["scalar"]["wall_s"]
+                    / max(arms["vmap"]["wall_s"], 1e-9), 2)
+    if speedup < need:
+        violations.append(
+            "vectorized trials/hour gate missed: scalar {}s / vmap {}s "
+            "= {}x (need >= {}x)".format(
+                arms["scalar"]["wall_s"], arms["vmap"]["wall_s"],
+                speedup, need))
+
+    # (b) bitwise per-lane loss parity at the engine level.
+    parity_violations = _vmap_lane_parity()
+    violations.extend(parity_violations)
+
+    # (c) vmap_lanes=1 is the scalar path bit-for-bit: identical
+    # journal-replayed schedule (same seed => same content-addressed ids).
+    schedule_parity = journal_schedule_parity(
+        arms["scalar"]["events"], arms["lanes1"]["events"],
+        label_a="scalar_trials", label_b="lanes1_trials",
+        platform_a=arms["scalar"]["platform"],
+        platform_b=arms["lanes1"]["platform"])
+    if not schedule_parity["match"]:
+        violations.append(
+            "vmap_lanes=1 executed a different schedule than the scalar "
+            "default: symmetric difference {}".format(
+                schedule_parity["symmetric_difference"]))
+    lanes1_tagged = [e for e in arms["lanes1"]["events"]
+                     if e.get("lane") is not None]
+    if lanes1_tagged:
+        violations.append(
+            "vmap_lanes=1 journaled {} lane-tagged edges; the scalar "
+            "path must be bit-for-bit untouched".format(len(lanes1_tagged)))
+
+    # (d) the vmap arm really rode blocks: all but the warm-up scalar
+    # dispatches should carry lane-tagged assignment edges.
+    lane_assigned = [e for e in arms["vmap"]["events"]
+                     if e.get("phase") == "assigned"
+                     and e.get("lane") is not None]
+    blocks = sorted({e.get("block") for e in lane_assigned})
+    if len(lane_assigned) < trials - lanes_k:
+        violations.append(
+            "vmap arm barely vectorized: only {}/{} trials rode blocks "
+            "(need >= {}) — block assembly is not engaging".format(
+                len(lane_assigned), trials, trials - lanes_k))
+
+    ok = not violations
+    print(json.dumps({
+        "metric": "vectorized micro-trials A/B (K configs per chip as one "
+                  "vmapped program, journal-replayed)",
+        "value": speedup if ok else 0.0,
+        "unit": "x_trials_per_hour_vs_scalar",
+        "detail": {"vmap_ab": {
+            "seed": seed, "trials": trials, "vmap_lanes": lanes_k,
+            "steps": VMAP_STEPS,
+            "wall_s": round(time.time() - t_start, 1),
+            "platform": "cpu (pinned; CPU-proxy micro-trials — "
+                        "comparable across hosts per the ROADMAP note)",
+            "violations": violations,
+            "speedup": speedup, "speedup_needed": need,
+            "wall_scalar_s": arms["scalar"]["wall_s"],
+            "wall_lanes1_s": arms["lanes1"]["wall_s"],
+            "wall_vmap_s": arms["vmap"]["wall_s"],
+            "lane_parity_lanes_checked": 4 - len(parity_violations),
+            "schedule_parity": schedule_parity,
+            "blocks": blocks,
+            "lane_assignments": len(lane_assigned),
+            # Chip-time ledger of the vectorized arm: block chip-seconds
+            # split across lanes, masked tails billed to lane_idle.
+            "goodput": arms["vmap"]["derived"].get("goodput"),
+            "goodput_scalar": arms["scalar"]["derived"].get("goodput"),
+        }},
+    }), flush=True)
+    return 0 if ok else 1
+
+
 def goodput_main():
     """``bench.py --goodput``: the chip-time ledger gate. Two
     journal-replayed A/Bs on ONE pinned platform prove the ledger
@@ -1209,12 +1471,7 @@ def goodput_main():
     CPU-pinned like --fork (closed-form/tiny trial bodies; the ledger
     under test is platform-independent journal arithmetic). Exit 1 on
     any gate failure."""
-    if "MAGGY_TPU_BASE_DIR" not in os.environ:
-        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    for var in _ACCEL_BOOTSTRAP_VARS:
-        os.environ.pop(var, None)
-    _force_cpu_if_requested()
+    _pin_bench_env(cpu=True)
     import glob as _glob
 
     from maggy_tpu import OptimizationConfig, Searchspace, experiment
@@ -1353,9 +1610,7 @@ def fleet_main():
     block carries the journal-replayed scheduling numbers (queue wait
     p50/p95, preemption count, share error vs the configured weights).
     Exit 1 if any fleet invariant is violated."""
-    if "MAGGY_TPU_BASE_DIR" not in os.environ:
-        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
-    _force_cpu_if_requested()
+    _pin_bench_env()
     from maggy_tpu.fleet.soak import run_fleet_soak
 
     seed = int(os.environ.get("BENCH_FLEET_SEED", "7"))
@@ -1393,17 +1648,9 @@ def pack_main():
     are comparable across hosts per the ROADMAP platform-gating note.
     Exit 1 if the sweep deadlocks, utilization misses the 0.7 gate, or a
     gang trial diverges from the single-process sharded reference."""
-    if "MAGGY_TPU_BASE_DIR" not in os.environ:
-        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
     # Before any jax import: the pack soak's topology is 8 fake host
     # devices, regardless of what accelerator the host has.
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    for var in _ACCEL_BOOTSTRAP_VARS:
-        os.environ.pop(var, None)
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
+    _pin_bench_env(cpu=True, fake_devices=8)
     from maggy_tpu.gang import run_pack_soak
 
     seed = int(os.environ.get("BENCH_PACK_SEED", "7"))
@@ -1463,11 +1710,7 @@ def obs_main():
     comparable per the ROADMAP flaky-TPU note — detail.platform records
     it). Exit 1 if the endpoints fail, stall, or disagree with the
     journal."""
-    if "MAGGY_TPU_BASE_DIR" not in os.environ:
-        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    for var in _ACCEL_BOOTSTRAP_VARS:
-        os.environ.pop(var, None)
+    _pin_bench_env(cpu=True)
     import glob
     import threading
     import urllib.error
@@ -1615,11 +1858,7 @@ def scale_main():
     agent join latency p50/p95, ABIND lease round-trip p50/p95, and
     churn completion — with ``detail.platform`` pinned the same way for
     comparability against the in-process rounds."""
-    if "MAGGY_TPU_BASE_DIR" not in os.environ:
-        os.environ["MAGGY_TPU_BASE_DIR"] = _mint_base_dir()
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    for var in _ACCEL_BOOTSTRAP_VARS:
-        os.environ.pop(var, None)
+    _pin_bench_env(cpu=True)
     seed = int(os.environ.get("BENCH_SCALE_SEED", "7"))
     platform_note = ("cpu pinned (forced; the control plane under test "
                      "is platform-independent — pinned for cross-round "
@@ -2126,6 +2365,8 @@ if __name__ == "__main__":
         sys.exit(failover_main())
     if "--fork" in sys.argv:
         sys.exit(fork_main())
+    if "--vmap" in sys.argv:
+        sys.exit(vmap_main())
     if "--goodput" in sys.argv:
         sys.exit(goodput_main())
     if "--fleet" in sys.argv:

@@ -16,6 +16,7 @@ the standard soak runs: a runner killed mid-trial, a false preemption,
     python -m maggy_tpu.chaos --driver                   # driver-kill soak
     python -m maggy_tpu.chaos --fork                     # fork-kill soak
     python -m maggy_tpu.chaos --goodput                  # fault-free ledger soak
+    python -m maggy_tpu.chaos --vmap                     # vectorized-block soak
     python -m maggy_tpu.chaos --show-schedule --seed 7   # no experiment
 
 ``--preempt`` runs the graceful-preemption soak: a mid-trial trial is
@@ -112,6 +113,15 @@ def main(argv=None) -> int:
                          "injected the chip-time fold must book ~zero "
                          "rework and keep the unaccounted residual at or "
                          "under 5% of held chip-time")
+    ap.add_argument("--vmap", action="store_true",
+                    help="run the vectorized-block soak: a vmap_lanes=4 "
+                         "sweep with the runner holding the first "
+                         "assembled K-lane block killed mid-block — "
+                         "every live lane must requeue exactly once as "
+                         "an individual scalar trial (non-leader lanes "
+                         "with reason vmap_block_lost), no phantom "
+                         "FINALs, no lane lost to the block seam "
+                         "(invariant 16)")
     ap.add_argument("--agent", action="store_true",
                     help="run the remote-agent soak: real agent daemon "
                          "processes (python -m maggy_tpu.fleet agent) "
@@ -157,13 +167,24 @@ def main(argv=None) -> int:
     from maggy_tpu.chaos.plan import FaultPlan
 
     modes = [m for m in ("stall", "piggyback", "preempt", "gang", "agent",
-                         "sink", "driver", "fork", "goodput")
+                         "sink", "driver", "fork", "goodput", "vmap")
              if getattr(args, m)]
     if args.plan and modes:
         ap.error("--{} uses a built-in plan; drop --plan".format(modes[0]))
     if len(modes) > 1:
         ap.error("pick one of --stall / --piggyback / --preempt / --gang "
-                 "/ --agent / --sink / --driver / --fork / --goodput")
+                 "/ --agent / --sink / --driver / --fork / --goodput "
+                 "/ --vmap")
+    if args.vmap:
+        # The vmap soak owns its whole config (float-only searchspace so
+        # every trial is program-compatible, vmap_lanes=4, 2 workers) —
+        # delegate wholesale.
+        report = harness.run_vmap_soak(
+            seed=7 if args.seed is None else args.seed,
+            num_trials=args.trials,
+            lock_witness=not args.no_witness)
+        print(json.dumps(report, indent=2, default=str))
+        return 0 if report["ok"] else 1
     if args.goodput:
         # The goodput control soak owns its whole config (an EMPTY
         # fault plan — the gate is on the ledger, not a recovery) —

@@ -84,6 +84,17 @@ checkable against any soak artifact after the fact):
     --driver``): a real driver process SIGKILLed mid-sweep over
     surviving runner-agent processes, restarted with ``resume=True``.
 
+16. **A vectorized block dies as a unit and recovers as individuals** —
+    when a runner-death fault lands while a K-lane vmap block
+    (config.vmap_lanes > 1) is in flight, every lane that had not
+    already finalized must be requeued EXACTLY once — non-leader lanes
+    with reason ``vmap_block_lost``, the leader through the ordinary
+    scalar LOST path — and re-run scalar to its own FINAL. No phantom
+    FINALs out of the dead block (invariant 2), no lane falling through
+    the block seam (invariant 1), no lane double-requeued by racing
+    recovery paths. ``vmap_plan``, ``python -m maggy_tpu.chaos
+    --vmap``.
+
 9.  **The observability plane survives the faults** — with
     ``run_soak(obs=True)`` the experiment runs with the obs HTTP server
     on (config.obs_port=0) while a scraper polls /metrics, /status and
@@ -857,6 +868,78 @@ def run_goodput_soak(seed: int = 7, num_trials: int = 12,
     return report
 
 
+def vmap_plan(seed: int = 7, nth: int = 4) -> FaultPlan:
+    """Vectorized-block soak (invariant 16): the runner holding the first
+    assembled K-lane block is killed at a lane's ``running`` edge. With 2
+    workers the first dispatch per runner precedes the prefetch queue
+    (running edges 1-2 are scalar), so edges 3+ are the first block's
+    leader + lanes — ``nth`` defaults onto a NON-leader lane of that
+    block, the case where the chaos event names a lane while the
+    reservation (and thus the LOST scan) names the leader."""
+    return FaultPlan([
+        FaultSpec("kill_runner", trigger={"on_phase": "running",
+                                          "nth": nth}),
+    ], seed=seed)
+
+
+def vmap_soak_train_fn(lr, lanes=None, reporter=None):
+    """Vmap soak trial: a heartbeat-paced closed-form quadratic, ~1.5 s
+    busy, lanes-capable. The scalar branch is mandatory — the first
+    dispatch per runner always precedes the prefetch queue, and every
+    requeued lane re-runs scalar (the recovery path under test)."""
+    import time as _time
+
+    if lanes is None:
+        for step in range(30):
+            reporter.broadcast(1.0 - (lr - 0.1) ** 2 + 1e-3 * step,
+                               step=step)
+            _time.sleep(0.05)
+        return 1.0 - (lr - 0.1) ** 2
+    lrs = [h["lr"] for h in lanes.hparams]
+    for step in range(30):
+        vals = [1.0 - (l - 0.1) ** 2 + 1e-3 * step for l in lrs]
+        reporter.broadcast_lanes(vals, step=step)
+        for i in lanes.take_stopped():
+            lanes.retire(i, float(vals[i]))
+        _time.sleep(0.05)
+    return {tid: 1.0 - (l - 0.1) ** 2
+            for tid, l in zip(lanes.trial_ids, lrs)}
+
+
+def run_vmap_soak(seed: int = 7, num_trials: int = 12, workers: int = 2,
+                  lanes: int = 4,
+                  base_dir: Optional[str] = None,
+                  lock_witness: Optional[bool] = None) -> Dict[str, Any]:
+    """The vectorized-block chaos soak: a float-only sweep (every trial
+    program-compatible, so blocks assemble as soon as the prefetch queue
+    fills) with ``vmap_lanes=lanes`` on a 2-runner thread fleet, under
+    ``vmap_plan`` — the runner holding the first assembled block killed
+    mid-block. Asserts invariant 16 on top of the standard suite, and
+    fails loudly if the kill never tore a block (a kill that landed on a
+    scalar trial verified nothing)."""
+    from maggy_tpu import Searchspace
+
+    plan = vmap_plan(seed)
+    report = run_soak(
+        plan=plan, seed=seed, train_fn=vmap_soak_train_fn,
+        num_trials=num_trials, workers=workers, pool="thread",
+        hb_interval=0.05, hb_loss_timeout=0.6, base_dir=base_dir,
+        lock_witness=lock_witness,
+        config_overrides=dict(
+            searchspace=Searchspace(lr=("DOUBLE", [0.0, 0.2])),
+            vmap_lanes=lanes,
+        ))
+    torn = [r for r in report.get("vmap_blocks", [])
+            if r.get("outcome") == "requeued"]
+    if not torn:
+        report["violations"].append(
+            "vmap fault never tore a block: the kill_runner injection "
+            "hit a scalar trial (or raced every lane's FINAL) — the soak "
+            "exercised nothing (tune vmap_plan's nth)")
+        report["ok"] = False
+    return report
+
+
 def check_invariants(events: List[Dict[str, Any]],
                      requeue_bound_s: Optional[float] = None,
                      stall_flag_bound_s: Optional[float] = 15.0
@@ -882,6 +965,10 @@ def check_invariants(events: List[Dict[str, Any]],
     forked_evs: Dict[str, List[Dict[str, Any]]] = {}
     gang_assembled: Dict[str, List[Dict[str, Any]]] = {}
     gang_released: Dict[str, List[Dict[str, Any]]] = {}
+    # Vectorized blocks (invariant 16): block leader id -> {lane trial id
+    # -> its lane-tagged assigned event}. Only block assignments carry a
+    # "block" field; scalar journals never enter this map.
+    block_lanes: Dict[str, Dict[str, Dict[str, Any]]] = {}
     parent_of: Dict[str, Any] = {}
     chaos_events: List[Dict[str, Any]] = []
     health_raised: List[Dict[str, Any]] = []
@@ -938,6 +1025,10 @@ def check_invariants(events: List[Dict[str, Any]],
         elif phase == "requeued":
             requeued.setdefault(trial, []).append(t)
             requeued_evs.setdefault(trial, []).append(dict(ev))
+        elif phase == "assigned":
+            if ev.get("block") is not None:
+                block_lanes.setdefault(ev["block"], {}).setdefault(
+                    trial, dict(ev))
         elif phase == "gang_assembled":
             gang_assembled.setdefault(trial, []).append(dict(ev))
         elif phase == "gang_released":
@@ -1180,6 +1271,65 @@ def check_invariants(events: List[Dict[str, Any]],
             rec["from_step"] = resumes[0].get("from_step")
         fork_recs.append(rec)
 
+    # Invariant 16: a vectorized block dies as a unit and recovers as
+    # individuals. A runner-death fault naming ANY lane of an in-flight
+    # block (the chaos event may name a non-leader lane — its running
+    # edge fired the trigger — while the reservation names the leader)
+    # must be followed by the exactly-once requeue of EVERY lane that
+    # had not already finalized; non-leader lanes carry reason
+    # vmap_block_lost. Phantom FINALs and lost lanes are invariants 1/2
+    # above; this block pins the seam-specific contract.
+    lane_block: Dict[str, str] = {}
+    for bid, lanes_map in block_lanes.items():
+        for tr in lanes_map:
+            lane_block.setdefault(tr, bid)
+    block_kills: Dict[str, List[Dict[str, Any]]] = {}
+    for ce in chaos_events:
+        if ce.get("kind") not in ("kill_runner", "kill_fork"):
+            continue
+        bid = lane_block.get(ce.get("trial"))
+        if bid is not None and ce.get("t") is not None:
+            block_kills.setdefault(bid, []).append(ce)
+    vmap_recs: List[Dict[str, Any]] = []
+    for bid, kills in sorted(block_kills.items()):
+        lanes_map = block_lanes[bid]
+        t0 = min(ce["t"] for ce in kills)
+        rec: Dict[str, Any] = {"block": bid,
+                               "lanes": sorted(lanes_map),
+                               "victim": kills[0].get("trial"),
+                               "partition": kills[0].get("partition")}
+        live = [tr for tr in sorted(lanes_map)
+                if not [t for t in finalized.get(tr, []) if t <= t0]]
+        if not live:
+            rec["outcome"] = "completed_before_detection"
+            vmap_recs.append(rec)
+            continue
+        rec["outcome"] = "requeued"
+        rec["live_lanes"] = live
+        for tr in live:
+            later = [e for e in requeued_evs.get(tr, [])
+                     if e.get("t") is not None and e["t"] >= t0]
+            n_req = len(requeued.get(tr, []))
+            if not later:
+                rec["outcome"] = "torn"
+                violations.append(
+                    "lane lost to the block seam: a runner-death fault "
+                    "tore block {} but live lane trial {} was never "
+                    "requeued".format(bid, tr))
+            elif n_req > len(kills):
+                violations.append(
+                    "lane over-requeue: trial {} (block {}) was requeued "
+                    "{} times for {} runner-death fault(s) on its "
+                    "block".format(tr, bid, n_req, len(kills)))
+            elif tr != bid and later[0].get("reason") not in (
+                    "vmap_block_lost", "preempted"):
+                violations.append(
+                    "lane requeue reason drift: non-leader lane {} of "
+                    "block {} requeued with reason {!r} (expected "
+                    "vmap_block_lost)".format(
+                        tr, bid, later[0].get("reason")))
+        vmap_recs.append(rec)
+
     # Invariant 5: stall -> health flag. A frozen runner shorter than the
     # loss bound is invisible to the heartbeat-loss scan; the health
     # engine's hang watchdog (or straggler scoring) must still see it,
@@ -1356,6 +1506,10 @@ def check_invariants(events: List[Dict[str, Any]],
         # outcome — the forked trial's requeue resumed from its exact
         # fork point with lineage intact.
         "forks": fork_recs,
+        # Invariant 16 (vectorized micro-trials): per torn block —
+        # every live lane requeued exactly once, non-leader lanes with
+        # reason vmap_block_lost.
+        "vmap_blocks": vmap_recs,
         "health": {"engine_ran": health_engine_ran,
                    "raised": len(health_raised),
                    "by_check": health_by_check,

@@ -214,6 +214,18 @@ class OptimizationConfig(LagomConfig):
     # scratch — the stamp resolves to no checkpoint and is skipped).
     # False restores from-scratch promotions bit-for-bit.
     fork: bool = True
+    # Vectorized micro-trials (docs/user.md "Vectorized sweeps"): the
+    # driver packs up to this many COMPATIBLE suggestions (same
+    # non-float params, same budget, no gang spec — the driver-side
+    # proxy for the warm-cache program key) into one block and delivers
+    # the whole block to one runner in a single TRIAL; the executor runs
+    # all lanes in lockstep as ONE vmapped program (train/vmap.py), so a
+    # small-model sweep fills the chip across the hyperparameter axis
+    # instead of one trial at a time. Early stop masks a lane without
+    # recompiling; each lane keeps its own span/METRIC/FINAL. 1 (the
+    # default) disables block assembly and restores the scalar dispatch
+    # path bit-for-bit.
+    vmap_lanes: int = 1
     # Capture a jax.profiler trace per trial into its TensorBoard dir.
     profile: bool = False
     # Tee the user train_fn's print() calls into the reporter log channel,
@@ -241,6 +253,16 @@ class OptimizationConfig(LagomConfig):
             raise ValueError(
                 "pool must be 'thread', 'process', 'tpu', 'elastic', or "
                 "'remote'")
+        if not isinstance(self.vmap_lanes, int) \
+                or isinstance(self.vmap_lanes, bool) or self.vmap_lanes < 1:
+            raise ValueError(
+                "vmap_lanes must be an int >= 1 (1 = scalar dispatch), "
+                "got {!r}".format(self.vmap_lanes))
+        if self.vmap_lanes > 1 and self.chips_per_budget is not None:
+            raise ValueError(
+                "vmap_lanes packs K trials onto ONE chip; gang-scheduled "
+                "sweeps (chips_per_budget) size trials the other way — "
+                "pick one")
         if self.chips_per_budget is not None and \
                 self.pool not in ("elastic", "thread"):
             raise ValueError(

@@ -190,6 +190,17 @@ class OptimizationDriver(Driver):
         # Fleet-level contiguous-block reservation held while gangs are
         # waiting or running (see FleetScheduler.request_gang).
         self._fleet_gang_active = False  # guarded-by: _store_lock
+        # ---- vectorized micro-trials (config.vmap_lanes; train/vmap.py) ----
+        # K>1: the dispatch path assembles up to K program-compatible
+        # suggestions into ONE block delivered to a single runner, which
+        # trains them in lockstep as one vmapped executable. K=1 keeps
+        # every code path below bit-for-bit scalar.
+        self._vmap_lanes = int(getattr(config, "vmap_lanes", 1) or 1)
+        # Assembled blocks in flight: leader trial id ->
+        # {"lanes": [trial_id, ...] (lane order), "partition": pid}.
+        self._vmap_blocks: Dict[str, Dict[str, Any]] = {}  # guarded-by: _store_lock
+        # Reverse map: lane trial id -> leader trial id.
+        self._lane_leader: Dict[str, str] = {}  # guarded-by: _store_lock
         # Outstanding resize requests by target size: bounds the idle-runner
         # migration so a herd of idle runners doesn't all chase one parked
         # trial's size (decremented when a runner REGisters at that size).
@@ -215,6 +226,9 @@ class OptimizationDriver(Driver):
         self.controller.trial_store = self._trial_store
         self.controller.final_store = self._final_store
         self.controller.direction = config.direction
+        # Lanes-aware optimizers (ASHA's K-at-a-time rung drain, BO's
+        # fork-lane discount) read this; everyone else ignores it.
+        self.controller.vmap_lanes = self._vmap_lanes
         self.controller._initialize(exp_dir=self.exp_dir)
 
         self.result = {"best_id": None, "best_val": None, "best_hp": None,
@@ -471,12 +485,28 @@ class OptimizationDriver(Driver):
 
     def _metric_msg_callback(self, msg) -> None:
         """Append heartbeat metric; early-stop check every es_interval steps
-        once es_min trials finalized (reference :331-361)."""
+        once es_min trials finalized (reference :331-361). A vectorized
+        block's beat carries ``lanes`` — K lane-tagged (trial_id, value,
+        step) entries, each applied as its own trial's metric so the
+        early-stop rule sees K independent streams."""
         self.add_executor_logs(msg.get("logs"))
-        trial = self.get_trial(msg.get("trial_id"))
-        if trial is None or msg.get("value") is None:
+        lanes = msg.get("lanes")
+        if lanes:
+            for beat in lanes:
+                self._apply_metric_beat(beat.get("trial_id"),
+                                        beat.get("value"), beat.get("step"),
+                                        msg.get("partition_id"),
+                                        lane=beat.get("lane"))
             return
-        appended = trial.append_metric(msg["value"], msg.get("step"))
+        self._apply_metric_beat(msg.get("trial_id"), msg.get("value"),
+                                msg.get("step"), msg.get("partition_id"))
+
+    def _apply_metric_beat(self, trial_id, value, step, partition_id,
+                           lane=None) -> None:
+        trial = self.get_trial(trial_id)
+        if trial is None or value is None:
+            return
+        appended = trial.append_metric(value, step)
         if not appended:
             return
         with trial.lock:
@@ -484,9 +514,12 @@ class OptimizationDriver(Driver):
         if n_steps == 1:
             # Scheduling pipeline milestone: time-to-first-signal. The
             # span's running->first_metric delta is the trial's
-            # startup/compile cost as the control plane sees it.
+            # startup/compile cost as the control plane sees it. The lane
+            # tag rides only on vectorized beats — scalar journals stay
+            # bit-identical to the K=1 path.
+            extra = {"lane": lane} if lane is not None else {}
             self.telemetry.trial_event(trial.trial_id, "first_metric",
-                                       partition=msg.get("partition_id"))
+                                       partition=partition_id, **extra)
         with self._store_lock:
             n_final = len(self._final_store)
         if n_final >= self.es_min and n_steps % self.es_interval == 0:
@@ -530,6 +563,11 @@ class OptimizationDriver(Driver):
                                        reason="blacklist")
             return
         if trial is not None:
+            # A blacklisted block leader: the non-leader lanes requeue as
+            # individual trials; the leader (vmap stamps stripped by the
+            # helper) is reassigned below as a plain scalar trial.
+            self._requeue_vmap_block(trial.trial_id, msg["partition_id"],
+                                     "vmap_block_lost")
             trial.reset_run_state()
             # Explicit requeue edge BEFORE the reassignment: recovery
             # latency (fault -> requeued -> assigned) must be derivable
@@ -555,6 +593,54 @@ class OptimizationDriver(Driver):
             self._log("executor {} restarted; trial {} requeued".format(
                 msg["partition_id"], msg["trial_id"]))
 
+    def _requeue_vmap_block(self, leader_id: str, partition_id,
+                            reason: str) -> bool:
+        """Tear down a dead vectorized block: every live NON-leader lane
+        requeues exactly once as an individual scalar trial (the leader
+        rides the caller's existing single-trial requeue path, so the
+        whole block — leader included — requeues exactly once: chaos
+        invariant 16). Lanes that already finalized stay finalized (no
+        phantom re-runs); vmap stamps are stripped so the re-dispatch is
+        plain scalar. Returns False when ``leader_id`` leads no block."""
+        with self._store_lock:
+            block = self._vmap_blocks.pop(leader_id, None)
+            if block is None:
+                return False
+            for tid in block["lanes"]:
+                self._lane_leader.pop(tid, None)
+        for tid in block["lanes"]:
+            trial = self.get_trial(tid)
+            if trial is None:
+                continue
+            with trial.lock:
+                trial.info_dict.pop("vmap", None)
+                trial.info_dict.pop("vmap_block", None)
+                done = trial.final_metric is not None or \
+                    trial.status == Trial.ERROR
+            if done or tid == leader_id:
+                continue
+            trial.reset_run_state()
+            with self._store_lock:
+                if tid not in self._requeue:
+                    self._requeue.append(tid)
+            # Literal reasons so the journalvocab emit scan sees them.
+            if reason == "preempted":
+                self.telemetry.trial_event(tid, "requeued",
+                                           partition=partition_id,
+                                           reason="preempted")
+            else:
+                self.telemetry.trial_event(tid, "requeued",
+                                           partition=partition_id,
+                                           reason="vmap_block_lost")
+        return True
+
+    def vmap_block_lanes(self, leader_id: str) -> List[str]:
+        """Lane trial ids of an in-flight block (empty when ``leader_id``
+        leads none) — chaos/bench introspection."""
+        with self._store_lock:
+            block = self._vmap_blocks.get(leader_id)
+            return list(block["lanes"]) if block else []
+
     def _lost_msg_callback(self, msg) -> None:
         """A runner's heartbeats went silent while holding a trial: the
         runner is presumed dead and the trial goes back into the schedule
@@ -563,6 +649,10 @@ class OptimizationDriver(Driver):
         trial = self.get_trial(msg["trial_id"])
         if trial is None:
             return
+        # A lost block leader takes all K lanes with it — the non-leader
+        # lanes requeue here; the leader requeues below like any scalar.
+        self._requeue_vmap_block(trial.trial_id, msg.get("partition_id"),
+                                 "vmap_block_lost")
         trial.reset_run_state()
         with self._store_lock:
             if trial.trial_id not in self._requeue:
@@ -1099,8 +1189,12 @@ class OptimizationDriver(Driver):
     def _prefetch_capacity(self) -> int:
         """Queue bound: one suggestion per live (registered, unreleased)
         runner, never more than the executor clamp (which already honors
-        the controller's max_concurrency)."""
-        return min(self.num_executors, self.server.reservations.live_count())
+        the controller's max_concurrency). Under vectorized trials the
+        bound scales by K — a runner consumes up to K suggestions per
+        hand-off, and a one-deep queue would starve block assembly down
+        to scalar dispatches."""
+        return min(self.num_executors,
+                   self.server.reservations.live_count()) * self._vmap_lanes
 
     def _refill_prefetch(self) -> bool:
         """One refill attempt; True when a suggestion was materialized
@@ -1323,11 +1417,16 @@ class OptimizationDriver(Driver):
             was_early_stop = trial.early_stop
         # "finalized": the hand-off gap's opening edge and the early-stop
         # reaction's closing edge — journaled BEFORE _assign_next so the
-        # journal's event order matches the control flow it measures.
+        # journal's event order matches the control flow it measures. Lane
+        # FINALs tag their lane/block so per-lane spans close attributably
+        # (and the goodput ledger can split block chip-time by lane).
+        extra = {}
+        if msg.get("block") is not None:
+            extra = {"lane": msg.get("lane"), "block": msg.get("block")}
         self.telemetry.trial_event(trial.trial_id, "finalized",
                                    partition=msg.get("partition_id"),
                                    early_stop=was_early_stop,
-                                   error=was_error)
+                                   error=was_error, **extra)
         with self._store_lock:
             self._trial_store.pop(trial.trial_id, None)
             self._final_store.append(trial)
@@ -1348,6 +1447,32 @@ class OptimizationDriver(Driver):
         # could still be in flight (or fail unobserved) when lagom returns.
         self.env.dump(trial.to_json(),
                       "{}/{}/trial.json".format(self.exp_dir, trial.trial_id))
+        if msg.get("block") is not None:
+            leader_id = msg["block"]
+            if not msg.get("last"):
+                # Mid-block lane FINAL (early-stopped/masked lane, or any
+                # lane before the closing one): the partition still holds
+                # the block — report to the controller NOW (the optimizer
+                # reacts at masking time, and stale prefetches drop) but
+                # hand off nothing.
+                with self._store_lock:
+                    self._lane_leader.pop(trial.trial_id, None)
+                if self._prefetch_enabled:
+                    self._ingest_final_report(trial)
+                else:
+                    # Blocks only assemble from the prefetch queue, but a
+                    # lane FINAL racing a config flip must not crash here.
+                    report = getattr(self.controller, "report", None)
+                    if report is not None:
+                        report(trial)
+                self._sweep_fork_gc()
+                return
+            # Closing lane: the block is done — drop its bookkeeping and
+            # run the normal hand-off (report + piggybacked next block).
+            with self._store_lock:
+                block = self._vmap_blocks.pop(leader_id, None)
+                for tid in (block or {}).get("lanes", ()):
+                    self._lane_leader.pop(tid, None)
         self._assign_next(msg["partition_id"], trial)
         # AFTER the hand-off (the freed runner never waits on disk ops):
         # retire parent checkpoints this FINAL made unforkable.
@@ -1389,6 +1514,10 @@ class OptimizationDriver(Driver):
                     return
             msg = {**msg, "step": None}
         step = msg.get("step")
+        # A preempted block leader takes its lanes with it: non-leader
+        # lanes requeue here as scalar trials; the leader follows the
+        # normal preemption path below.
+        self._requeue_vmap_block(trial.trial_id, pid, "preempted")
         trial.reset_run_state()
         # A preempted gang trial releases its slice like any other
         # terminal path; reassembly happens from the requeue backlog.
@@ -1757,10 +1886,126 @@ class OptimizationDriver(Driver):
                                       "forked_from", {}).get("trial"))))
                 return True  # runner still free: pull the next suggestion
             suggestion.set_status(Trial.SCHEDULED)
+            if self._vmap_lanes > 1 and \
+                    self._assemble_vmap_block_locked(suggestion,
+                                                     partition_id):
+                return
             self.server.reservations.assign_trial(partition_id, suggestion.trial_id)
             self.telemetry.trial_event(suggestion.trial_id, "assigned",
                                        partition=partition_id)
             self._journal_fork_edge(suggestion, partition_id)
+
+    # --------------------------------- vectorized micro-trials (vmap blocks)
+
+    def _vmap_blockable_locked(self, trial: Trial) -> bool:
+        """Can this trial ride a vectorized block? Unhashable params (no
+        program key), gang trials (multi-chip mesh), and checkpoint
+        resumers/forks (per-lane state restore has no vmapped analogue)
+        all fall back to scalar dispatch. A BO near-duplicate keeps its
+        ``parent`` tag and is admitted as a FORK LANE — it trains from
+        scratch next to its parent's family (warm-started-neighbor, not
+        checkpoint-restored)."""
+        try:
+            hash(tuple(sorted(trial.params.items())))
+        except TypeError:
+            return False
+        spec = self._gang_spec_for(trial)
+        if spec is not None and spec.chips > 1:
+            return False
+        with trial.lock:
+            info = dict(trial.info_dict)
+        if info.get("resume_step") is not None or info.get("forked_from"):
+            return False
+        if info.get("parent") and not info.get("near_duplicate"):
+            return False
+        return True
+
+    @staticmethod
+    def _vmap_compatible(a: Trial, b: Trial) -> bool:
+        """Same vmapped program? Proxy for the PR-6 warm-cache program key
+        the runner will resolve: identical trial type and param names, and
+        identical NON-FLOAT param values — float params are the stacked
+        hyperparameter axis (swept_transform traces them as inputs, so any
+        value shares one HLO), while ints/strings/bools steer model
+        config, shapes, or optimizer family and force a separate program."""
+        if a.trial_type != b.trial_type or set(a.params) != set(b.params):
+            return False
+        for key, va in a.params.items():
+            vb = b.params[key]
+            if isinstance(va, float) and isinstance(vb, float):
+                continue
+            if va != vb:
+                return False
+        return True
+
+    # locked-by: _sched_lock
+    def _assemble_vmap_block_locked(self, leader: Trial,
+                                    partition_id: int) -> bool:
+        """Assemble up to K program-compatible suggestions (the leader +
+        prefetched candidates) into ONE block delivery. True = the block
+        was assigned (>= 2 lanes); False = nothing to vectorize (or the
+        leader itself is block-incompatible) — the caller dispatches the
+        leader scalar, bit-for-bit the K=1 path."""
+        if not self._vmap_blockable_locked(leader):
+            return False
+        lanes = [leader]
+        for cand in list(self._prefetched):
+            if len(lanes) >= self._vmap_lanes:
+                break
+            if not self._vmap_blockable_locked(cand) or \
+                    not self._vmap_compatible(leader, cand):
+                continue
+            self._prefetched.remove(cand)
+            self._prefetch_versions.pop(cand.trial_id, None)
+            lanes.append(cand)
+        if len(lanes) < 2:
+            return False
+        # The queue just drained by K-1: let the suggester top it up.
+        self._suggest_wake.set()
+        lane_descs = []
+        for i, t in enumerate(lanes):
+            if i > 0:
+                # Prefetched lanes were admitted but never dispatched:
+                # mint their spans now (queued edge), like the scalar
+                # dispatch path does for the leader.
+                self._mint_span(t)
+                t.set_status(Trial.SCHEDULED)
+            with t.lock:
+                if t.info_dict.get("near_duplicate") and \
+                        t.info_dict.get("parent"):
+                    # BO fork_eps under lanes: the near-duplicate rides
+                    # the block as a fork lane — fresh init next to the
+                    # parent's program family, NOT a checkpoint restore
+                    # (strip any fork stamp _mint_span applied).
+                    t.info_dict.pop("forked_from", None)
+                    t.info_dict.pop("resume_step", None)
+                    t.info_dict["fork_lane"] = {
+                        "parent": t.info_dict["parent"]}
+                t.info_dict["vmap"] = {"lane": i, "block": leader.trial_id}
+                t.info_dict["epoch"] = t.run_epoch
+                lane_descs.append({"trial_id": t.trial_id, "lane": i,
+                                   "params": dict(t.params),
+                                   "span": t.info_dict.get("span"),
+                                   "epoch": t.run_epoch,
+                                   "fork_lane": t.info_dict.get(
+                                       "fork_lane")})
+        with leader.lock:
+            leader.info_dict["vmap_block"] = {"lanes": lane_descs}
+        with self._store_lock:
+            self._vmap_blocks[leader.trial_id] = {
+                "lanes": [t.trial_id for t in lanes],
+                "partition": partition_id}
+            for t in lanes:
+                self._lane_leader[t.trial_id] = leader.trial_id
+        self.server.reservations.assign_trial(partition_id,
+                                              leader.trial_id)
+        for i, t in enumerate(lanes):
+            self.telemetry.trial_event(t.trial_id, "assigned",
+                                       partition=partition_id, lane=i,
+                                       block=leader.trial_id)
+        self._log("vmap block {}: {} lanes assigned to runner {}".format(
+            leader.trial_id, len(lanes), partition_id))
+        return True
 
     def _mint_span(self, trial: Trial) -> None:
         """Mint the trial's telemetry span when the driver commits to it

@@ -194,3 +194,52 @@ class TrialContext:
         if self._checkpointer is not None:
             self._checkpointer.close()
             self._checkpointer = None
+
+
+class LaneSet:
+    """The train fn's view of a vectorized K-lane block (config.vmap_lanes
+    > 1): the per-lane hyperparameters to stack into a `VmapTrainer`, the
+    per-lane stop signals the driver's early-stop rule raises, and the
+    per-lane retirement hook that sends each lane's own FINAL. A train fn
+    opts in by declaring a ``lanes`` keyword parameter; without it the
+    executor degrades the block to sequential scalar runs."""
+
+    def __init__(self, lanes, reporter, finalize):
+        # Lane descriptors in lane order: {"trial_id", "lane", "params",
+        # "span", "epoch", "fork_lane"} (from the block's TRIAL info).
+        self.lanes = [dict(entry) for entry in lanes]
+        self.reporter = reporter
+        self._finalize = finalize
+        self._by_id = {entry["trial_id"]: i
+                       for i, entry in enumerate(self.lanes)}
+
+    def __len__(self) -> int:
+        return len(self.lanes)
+
+    @property
+    def trial_ids(self):
+        return [entry["trial_id"] for entry in self.lanes]
+
+    @property
+    def hparams(self):
+        """Per-lane param dicts, lane order — feed to VmapTrainer (the
+        caller picks which keys form the stacked hyperparameter axis)."""
+        return [dict(entry.get("params") or {}) for entry in self.lanes]
+
+    def lane_of(self, trial_id: str) -> int:
+        return self._by_id[trial_id]
+
+    def take_stopped(self):
+        """Lane INDICES newly flagged for early stop (each exactly once):
+        poll between steps, mask them (`VmapTrainer.mask_lane`), then
+        `retire()` each with its final metric."""
+        return [self._by_id[tid]
+                for tid in self.reporter.take_stopped_lanes()
+                if tid in self._by_id]
+
+    def retire(self, lane: int, metric) -> None:
+        """Send lane ``lane``'s FINAL now (mid-block): its span closes at
+        the moment it stopped contributing, so masked-lane idle time is
+        attributable (goodput ``lane_idle``). Lanes never retired here are
+        finalized by the executor when the train fn returns."""
+        self._finalize(self.lanes[lane], metric)

@@ -179,6 +179,16 @@ class TrialExecutor:
                     self._run_gang_member(trial_id, params, client,
                                           reporter)
                     continue
+                if (client.last_info or {}).get("vmap_block"):
+                    # Vectorized K-lane block (config.vmap_lanes): one
+                    # delivery, K trials trained in lockstep as one
+                    # vmapped program — or sequentially when the train fn
+                    # doesn't take a ``lanes`` kwarg. Sends one FINAL per
+                    # lane; the loop resumes polling after the last.
+                    self._run_vmap_block(trial_id, params, client,
+                                         reporter, stats, env, exp_dir,
+                                         sig_params)
+                    continue
                 trial_dir = "{}/{}".format(exp_dir, trial_id)
                 env.mkdir(trial_dir)
                 env.dump(util.json_dumps_safe(params), trial_dir + "/.hparams.json")
@@ -347,6 +357,195 @@ class TrialExecutor:
                      "({}ms load).".format(
                          trial_id, fork.get("trial"), staged,
                          round((_time.monotonic() - t0) * 1e3, 1)))
+
+    def _run_vmap_block(self, leader_id: str, params: dict, client,
+                        reporter, stats, env, exp_dir: str,
+                        sig_params) -> None:
+        """Run a vectorized K-lane block: one delivery, K trials, one
+        vmapped program (train/vmap.py). The train fn opts into
+        vectorized execution by declaring a ``lanes`` keyword (a
+        `LaneSet`); otherwise the block degrades to sequential scalar
+        runs of each lane. Either way every lane sends its OWN FINAL —
+        the last one (``last=True``) releases the partition and banks the
+        piggybacked next assignment."""
+        import traceback as _tb
+
+        from maggy_tpu.core.executors.context import LaneSet
+        from maggy_tpu.train import warm
+
+        info = client.last_info or {}
+        lane_descs = list((info.get("vmap_block") or {}).get("lanes") or ())
+        if not lane_descs:
+            # Defensive: a block stamp with no lanes — treat the leader
+            # as a scalar trial failure rather than hanging the partition.
+            client.finalize_error(leader_id, reporter)
+            return
+        for entry in lane_descs:
+            lane_dir = "{}/{}".format(exp_dir, entry["trial_id"])
+            env.mkdir(lane_dir)
+            env.dump(util.json_dumps_safe(entry.get("params") or {}),
+                     lane_dir + "/.hparams.json")
+        if "lanes" not in sig_params:
+            self._run_block_sequential(leader_id, lane_descs, client,
+                                       reporter, stats, env, exp_dir,
+                                       sig_params)
+            return
+        reporter.reset_lanes(leader_id, info.get("span"), lane_descs)
+        stats.trial_start(leader_id)
+        finalized = []
+
+        def finalize(entry, metric, last=False, error=False):
+            if entry["trial_id"] in finalized:
+                return
+            finalized.append(entry["trial_id"])
+            if metric is not None and not error:
+                lane_dir = "{}/{}".format(exp_dir, entry["trial_id"])
+                env.dump(util.json_dumps_safe(
+                    {self.optimization_key: metric}),
+                    lane_dir + "/.outputs.json")
+                env.dump(str(float(metric)), lane_dir + "/.metric")
+            client.finalize_lane(entry["trial_id"], metric, reporter,
+                                 lane=entry.get("lane", 0),
+                                 block=leader_id,
+                                 epoch=entry.get("epoch"),
+                                 last=last, error=error)
+
+        lanes = LaneSet(lane_descs, reporter, finalize)
+        call_params = dict(params)
+        call_params["lanes"] = lanes
+        if "reporter" in sig_params:
+            call_params["reporter"] = reporter
+        try:
+            with warm.trial_scope(trial_id=leader_id,
+                                  enabled=self.warm_start, stats=stats,
+                                  fresh_state=False):
+                retval = self._run_trial(
+                    call_params, "{}/{}".format(exp_dir, leader_id),
+                    reporter)
+            metrics = self._lane_metrics(retval, lane_descs)
+            remaining = [e for e in lane_descs
+                         if e["trial_id"] not in finalized]
+            for i, entry in enumerate(remaining):
+                finalize(entry, metrics.get(entry["trial_id"]),
+                         last=(i == len(remaining) - 1))
+            if not remaining:
+                # Every lane was retired mid-block (all early-stopped):
+                # the partition still holds the block — a release-shaped
+                # FINAL (last=True, duplicate trial id the driver drops)
+                # frees it and banks the piggybacked next assignment.
+                client.finalize_lane(leader_id, None, reporter,
+                                     lane=0, block=leader_id,
+                                     epoch=lane_descs[0].get("epoch"),
+                                     last=True)
+        except EarlyStopException:
+            if reporter.take_preempt():
+                reporter.log("Block {} preempted; all lanes requeue."
+                             .format(leader_id))
+                client.preempt_ack(leader_id, reporter, step=None)
+            else:
+                # broadcast_lanes only raises on a whole-block stop
+                # (preempt); anything else is a contract break — error
+                # out the unfinalized lanes so none hangs the schedule.
+                self._error_out_lanes(leader_id, lane_descs, finalized,
+                                      client, reporter)
+        except Exception:  # noqa: BLE001 - report block error, keep worker alive
+            reporter.log("Block {} failed:\n{}".format(
+                leader_id, _tb.format_exc()))
+            self._error_out_lanes(leader_id, lane_descs, finalized,
+                                  client, reporter)
+        finally:
+            stats.trial_end(leader_id)
+
+    def _lane_metrics(self, retval, lane_descs) -> dict:
+        """Normalize a lanes-capable train fn's return value to
+        {trial_id: metric}: a dict keyed by lane trial id, or a sequence
+        in lane order."""
+        if isinstance(retval, dict):
+            return {tid: retval.get(tid) for tid in
+                    (e["trial_id"] for e in lane_descs)}
+        if isinstance(retval, (list, tuple)) and \
+                len(retval) == len(lane_descs):
+            return {e["trial_id"]: float(v)
+                    for e, v in zip(lane_descs, retval)}
+        from maggy_tpu.exceptions import ReturnTypeError
+
+        raise ReturnTypeError(self.optimization_key, retval)
+
+    def _error_out_lanes(self, leader_id, lane_descs, finalized, client,
+                         reporter) -> None:
+        """FINAL every unfinalized lane as an error (last one releases
+        the partition); if all lanes already finalized, send the
+        release-shaped duplicate instead."""
+        remaining = [e for e in lane_descs
+                     if e["trial_id"] not in finalized]
+        for i, entry in enumerate(remaining):
+            finalized.append(entry["trial_id"])
+            client.finalize_lane(entry["trial_id"], None, reporter,
+                                 lane=entry.get("lane", 0),
+                                 block=leader_id,
+                                 epoch=entry.get("epoch"),
+                                 last=(i == len(remaining) - 1),
+                                 error=True)
+        if not remaining:
+            client.finalize_lane(leader_id, None, reporter, lane=0,
+                                 block=leader_id,
+                                 epoch=lane_descs[0].get("epoch"),
+                                 last=True)
+
+    def _run_block_sequential(self, leader_id: str, lane_descs, client,
+                              reporter, stats, env, exp_dir: str,
+                              sig_params) -> None:
+        """Scalar fallback for a block whose train fn takes no ``lanes``
+        kwarg: run each lane as an ordinary scalar trial on this runner,
+        back to back — correctness degradation only, the block seam stays
+        invisible to the user code (per-lane reporter resets, per-lane
+        FINALs)."""
+        import traceback as _tb
+
+        from maggy_tpu.train import warm
+
+        for i, entry in enumerate(lane_descs):
+            tid = entry["trial_id"]
+            last = i == len(lane_descs) - 1
+            lane_dir = "{}/{}".format(exp_dir, tid)
+            reporter.reset(trial_id=tid, span=entry.get("span"))
+            stats.trial_start(tid)
+            call_params = dict(entry.get("params") or {})
+            if "reporter" in sig_params:
+                call_params["reporter"] = reporter
+            try:
+                with warm.trial_scope(trial_id=tid,
+                                      enabled=self.warm_start,
+                                      stats=stats, fresh_state=False):
+                    retval = self._run_trial(call_params, lane_dir,
+                                             reporter)
+                metric = util.handle_return_val(
+                    retval, lane_dir, self.optimization_key, env)
+                client.finalize_lane(tid, metric, reporter,
+                                     lane=entry.get("lane", i),
+                                     block=leader_id,
+                                     epoch=entry.get("epoch"), last=last)
+            except EarlyStopException as e:
+                if reporter.take_preempt():
+                    client.preempt_ack(leader_id, reporter, step=None)
+                    return
+                env.dump(util.json_dumps_safe(
+                    {self.optimization_key: e.metric}),
+                    lane_dir + "/.outputs.json")
+                client.finalize_lane(tid, e.metric, reporter,
+                                     lane=entry.get("lane", i),
+                                     block=leader_id,
+                                     epoch=entry.get("epoch"), last=last)
+            except Exception:  # noqa: BLE001 - report lane error, run the rest
+                reporter.log("Lane trial {} failed:\n{}".format(
+                    tid, _tb.format_exc()))
+                client.finalize_lane(tid, None, reporter,
+                                     lane=entry.get("lane", i),
+                                     block=leader_id,
+                                     epoch=entry.get("epoch"), last=last,
+                                     error=True)
+            finally:
+                stats.trial_end(tid)
 
     def _run_gang_member(self, trial_id: str, params: dict, client,
                          reporter) -> None:

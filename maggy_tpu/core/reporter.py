@@ -42,6 +42,17 @@ class Reporter:
         self._print_tee = print_tee
         self._metric_cache = None  # guarded-by: lock  # (device_array, float, step) identity triple
         self._async_kick = None  # guarded-by: lock  # device array with an in-flight D2H copy
+        # ---- vectorized (K-lane) trial blocks (train/vmap.py) ----
+        # Lane descriptors for the current block, in lane order:
+        # [{"trial_id", "span", "lane"}, ...]. None = scalar trial.
+        self._lanes = None  # guarded-by: lock
+        self._lane_vec = None  # guarded-by: lock  # lazy (K,) loss vector
+        self._lane_step: Optional[int] = None  # guarded-by: lock
+        self._lane_cache = None  # guarded-by: lock  # (vec_identity, [floats], step)
+        # Lane trial ids the driver flagged for early stop; _new holds the
+        # ones the training loop hasn't consumed (take_stopped_lanes) yet.
+        self._lane_stops: set = set()  # guarded-by: lock
+        self._lane_stops_new: set = set()  # guarded-by: lock
 
     # ------------------------------------------------------------- user API
 
@@ -107,6 +118,72 @@ class Reporter:
                 stats.on_broadcast(self.step)
             if self._stop_flag:
                 raise exceptions.EarlyStopException(self._materialize(self.metric))
+
+    def broadcast_lanes(self, values, step: Optional[int] = None) -> None:
+        """Vectorized-trial analogue of `broadcast()`: report the per-lane
+        loss vector of a K-lane block (train/vmap.py `VmapTrainer.step`
+        output). ``values`` must have length K (one entry per lane, masked
+        lanes included — their entries are dead compute and are dropped at
+        ship time). Kept LAZY like `broadcast()`: a jax (K,) array is not
+        synced here; the heartbeat thread materializes it in `get_data()`.
+
+        Raises `EarlyStopException` when the whole BLOCK is stopped (a
+        scheduler preemption) — per-lane stops never raise; they surface
+        via `take_stopped_lanes()` so the training loop can mask the lane
+        without tearing down the block."""
+        with self.lock:
+            if self._lanes is None:
+                raise exceptions.BroadcastMetricTypeError(values)
+            k = len(self._lanes)
+            shape = getattr(values, "shape", None)
+            n = shape[0] if shape else len(values)
+            if shape is not None and len(shape) != 1 or n != k:
+                raise exceptions.BroadcastMetricTypeError(values)
+            if step is not None and (not isinstance(step, (int, np.integer)) or isinstance(step, bool)):
+                raise exceptions.BroadcastStepTypeError(step)
+            if step is None:
+                step = self._lane_step + 1 if self._lane_step is not None else 0
+            elif self._lane_step is not None and step <= self._lane_step:
+                raise exceptions.BroadcastStepValueError(step, self._lane_step)
+            self._lane_vec = values
+            self._lane_step = int(step)
+            # Mirror into the scalar fields so code keyed on "has this
+            # trial reported yet" (early_stop arming, preempt acks) works:
+            # the block's leader beat is step-aligned with the lanes.
+            self.step = self._lane_step
+            stats = self.stats
+            if stats is not None:
+                stats.on_broadcast(self._lane_step)
+            if self._stop_flag:
+                raise exceptions.EarlyStopException(None)
+
+    def stop_lanes(self, trial_ids) -> None:
+        """Flag individual lanes of the current block for early stop (the
+        heartbeat thread applies the server's ``stop_lanes`` reply here).
+        Unknown / stale trial ids are ignored."""
+        with self.lock:
+            if not self._lanes:
+                return
+            known = {entry["trial_id"] for entry in self._lanes}
+            for tid in trial_ids or ():
+                if tid in known and tid not in self._lane_stops:
+                    self._lane_stops.add(tid)
+                    self._lane_stops_new.add(tid)
+
+    def take_stopped_lanes(self) -> List[str]:
+        """Consume newly stop-flagged lane trial ids (each id is returned
+        exactly once). The training loop polls this between steps and masks
+        the named lanes (`VmapTrainer.mask_lane`) — no recompile, no
+        exception."""
+        with self.lock:
+            fresh = sorted(self._lane_stops_new)
+            self._lane_stops_new = set()
+            return fresh
+
+    def stopped_lanes(self) -> List[str]:
+        """All lane trial ids flagged so far this block (consumed or not)."""
+        with self.lock:
+            return sorted(self._lane_stops)
 
     @staticmethod
     def _materialize(metric):
@@ -181,14 +258,42 @@ class Reporter:
                     metric, step = cached[1], cached[2]
                 else:
                     metric, step = None, None
+        lanes_out = self._lane_data(tid)
         with self.lock:
             logs = self._log_buffer
             self._log_buffer = []
         # trial_id/span are the ones the (metric, step) pair belongs to —
         # callers must ship THESE, not re-read reporter fields (which may
         # have rolled over to the next trial mid-call).
-        return {"metric": metric, "step": step, "logs": logs,
+        data = {"metric": metric, "step": step, "logs": logs,
                 "trial_id": tid, "span": span}
+        if lanes_out is not None:
+            data["lanes"] = lanes_out
+        return data
+
+    def _lane_data(self, tid) -> Optional[List[Dict[str, Any]]]:
+        """Materialize the newest per-lane loss vector into lane-tagged beat
+        entries (one dict per LIVE lane). None when not in lane mode or no
+        vector was broadcast yet. Runs on the heartbeat thread — the single
+        (K,) device sync here replaces K scalar syncs."""
+        with self.lock:
+            lanes, vec, vstep = self._lanes, self._lane_vec, self._lane_step
+            stops = set(self._lane_stops)
+            cached = self._lane_cache
+        if lanes is None or vec is None:
+            return None
+        if cached is not None and cached[0] is vec:
+            values, vstep = cached[1], cached[2]
+        else:
+            values = [float(v) for v in np.asarray(vec).reshape(-1)]
+            with self.lock:
+                if self.trial_id == tid:
+                    self._lane_cache = (vec, values, vstep)
+        return [{"trial_id": entry["trial_id"], "value": values[i],
+                 "step": vstep, "span": entry.get("span"),
+                 "lane": entry.get("lane", i)}
+                for i, entry in enumerate(lanes)
+                if entry["trial_id"] not in stops]
 
     def early_stop(self, trial_id: Optional[str] = None,
                    preempt: bool = False) -> None:
@@ -198,6 +303,21 @@ class Reporter:
         PREVIOUS trial's data must not stop the trial that replaced it.
         ``preempt`` marks the stop as a scheduler preemption."""
         with self.lock:
+            if self._lanes is not None:
+                # Vectorized block: a preempt stops the WHOLE block (the
+                # executor acks and the driver requeues every lane); a
+                # plain per-lane stop is routed to the lane-mask path.
+                lane_ids = {entry["trial_id"] for entry in self._lanes}
+                if trial_id is not None and trial_id != self.trial_id \
+                        and trial_id not in lane_ids:
+                    return
+                if preempt:
+                    if self._lane_step is not None or self.metric is not None:
+                        self._stop_flag = True
+                        self._preempt_flag = True
+                elif trial_id is not None:
+                    self.stop_lanes([trial_id])
+                return
             if trial_id is not None and trial_id != self.trial_id:
                 return
             if self.metric is not None:
@@ -226,3 +346,23 @@ class Reporter:
             self.span = span
             self._metric_cache = None
             self._async_kick = None
+            self._lanes = None
+            self._lane_vec = None
+            self._lane_step = None
+            self._lane_cache = None
+            self._lane_stops = set()
+            self._lane_stops_new = set()
+
+    def reset_lanes(self, trial_id: str, span: Optional[str],
+                    lanes: List[Dict[str, Any]]) -> None:
+        """Arm the reporter for a vectorized K-lane block. ``trial_id`` /
+        ``span`` are the block LEADER's (the trial the partition is
+        assigned); ``lanes`` are the per-lane descriptors from the TRIAL
+        reply's ``vmap_block`` info — each needs at least trial_id/span/lane.
+        """
+        self.reset(trial_id=trial_id, span=span)
+        with self.lock:
+            self._lanes = [{"trial_id": entry["trial_id"],
+                            "span": entry.get("span"),
+                            "lane": entry.get("lane", i)}
+                           for i, entry in enumerate(lanes)]

@@ -1330,6 +1330,8 @@ class OptimizationServer(Server):
             # and frees itself; the driver already requeued the trial.
             return {"type": "STOP", "span": msg.get("span"),
                     "preempt": True}
+        if msg.get("lanes"):
+            return self._metric_lanes(msg, trial_id)
         stop = False
         if trial_id:
             trial = self.driver.get_trial(trial_id)
@@ -1353,6 +1355,40 @@ class OptimizationServer(Server):
                     "preempt": bool(trial and trial.get_preempt())}
         return {"type": "OK"}
 
+    def _metric_lanes(self, msg, leader_id):
+        """STOP routing for a vectorized block's heartbeat (one beat, K
+        lane-tagged metric entries). Early stopping a lane must NOT tear
+        down the block — the reply carries ``stop_lanes`` and the runner
+        masks those lanes in place (train/vmap.py). A STOP reply is
+        reserved for scheduler preemption, which aborts the whole block."""
+        telem = self.telemetry
+        stop_lanes = []
+        preempt = False
+        for beat in msg["lanes"]:
+            lane_trial = self.driver.get_trial(beat.get("trial_id"))
+            if lane_trial is None or not lane_trial.get_early_stop():
+                continue
+            if lane_trial.get_preempt():
+                preempt = True
+                continue
+            stop_lanes.append(beat["trial_id"])
+            if telem is not None:
+                # once=True for the same reason as the scalar stop_sent:
+                # the lane keeps appearing in beats until the runner's
+                # training loop reaches its next mask boundary.
+                telem.trial_event(beat["trial_id"], "stop_sent", once=True,
+                                  partition=int(msg["partition_id"]),
+                                  lane=beat.get("lane"))
+        leader = self.driver.get_trial(leader_id) if leader_id else None
+        if preempt or (leader and leader.get_early_stop()
+                       and leader.get_preempt()):
+            return {"type": "STOP", "span": msg.get("span"),
+                    "preempt": True}
+        reply = {"type": "OK"}
+        if stop_lanes:
+            reply["stop_lanes"] = stop_lanes
+        return reply
+
     def _final(self, msg):
         """FINAL dispatch wrapper: the durability barrier runs AFTER the
         handler, BEFORE the reply is written (the dispatcher sends the
@@ -1373,10 +1409,22 @@ class OptimizationServer(Server):
     def _final_unbarriered(self, msg):
         self.reservations.touch(msg["partition_id"])
         self._note_adopted(msg["partition_id"])
+        if msg.get("block") is not None and not msg.get("last"):
+            # Per-lane FINAL of a vectorized block (one FINAL per lane,
+            # train/vmap.py): the partition still holds the block — no
+            # assignment clear, no piggybacked hand-off. The driver
+            # reports the lane's result to the controller inline so the
+            # optimizer sees it at masking time, not at block teardown.
+            fast = getattr(self.driver, "process_final_inline", None)
+            if fast is None or not fast(msg):
+                self.driver.enqueue(dict(msg))
+            return {"type": "OK"}
         # Conditional, not assign_trial(None): a RETRIED final (severed /
         # lost reply) must not wipe the next trial assigned in between.
+        # For a block's LAST lane the partition's assignment is the block
+        # LEADER, which the closing lane need not be — clear by leader.
         self.reservations.clear_trial_if(msg["partition_id"],
-                                         msg.get("trial_id"))
+                                         msg.get("block") or msg.get("trial_id"))
         # Pipelined hand-off (config.prefetch): the driver processes the
         # FINAL inline on this thread — report to the controller, drop any
         # schedule-stale prefetched suggestion, pick the next assignment —
@@ -1461,6 +1509,28 @@ class OptimizationServer(Server):
             telem.trial_event(trial.trial_id, "running",
                               partition=int(partition_id),
                               epoch=info.get("epoch"))
+        block = info.get("vmap_block")
+        if block:
+            # Vectorized block delivery: every lane enters RUNNING with the
+            # leader — each gets its own running edge so per-lane spans
+            # (queued -> running -> finalized) close without inference.
+            for entry in block.get("lanes", ()):
+                if entry["trial_id"] == trial.trial_id:
+                    continue
+                lane_trial = self.driver.get_trial(entry["trial_id"])
+                if lane_trial is None:
+                    continue
+                lane_trial.set_status(Trial.RUNNING)
+                lane_trial.start = time.time()
+                with lane_trial.lock:
+                    lane_trial.info_dict["partition"] = partition_id
+                    lane_trial.info_dict["epoch"] = lane_trial.run_epoch
+                if telem is not None:
+                    telem.trial_event(entry["trial_id"], "running",
+                                      partition=int(partition_id),
+                                      epoch=entry.get("epoch"),
+                                      lane=entry.get("lane"),
+                                      block=trial.trial_id)
         return {"type": "TRIAL", "trial_id": trial.trial_id,
                 "params": trial.params, "info": info,
                 "span": info.get("span")}
@@ -1820,6 +1890,11 @@ class Client:
                            # The span the (metric, step) pair belongs to —
                            # same rollover rule as sent_tid.
                            "span": data.get("span")}
+                if data.get("lanes"):
+                    # Vectorized block: one beat, K lane-tagged metric
+                    # entries (the batched-beat path ships them as one
+                    # frame either way).
+                    payload["lanes"] = data["lanes"]
                 stats = self.runner_stats
                 delta = None
                 if stats is not None:
@@ -1856,6 +1931,12 @@ class Client:
                         reporter.early_stop(trial_id=sent_tid,
                                             preempt=bool(
                                                 resp.get("preempt")))
+                    elif resp.get("stop_lanes"):
+                        # Per-lane early stops of a vectorized block: the
+                        # training loop consumes these via
+                        # take_stopped_lanes() and masks the lanes in
+                        # place — the block keeps running.
+                        reporter.stop_lanes(resp["stop_lanes"])
                 except ConnectionError:
                     if stats is not None and delta:
                         # The ship failed — put the delta back so the next
@@ -1997,6 +2078,33 @@ class Client:
             )
             reporter.reset()
         self._handle_final_reply(resp)
+        return resp
+
+    def finalize_lane(self, trial_id: str, metric, reporter, *,
+                      lane: int, block: str, epoch=None, last: bool = False,
+                      error: bool = False) -> Dict[str, Any]:
+        """Send one lane's FINAL for a vectorized K-lane block. Every lane
+        gets its own FINAL; only the ``last`` one releases the partition
+        (the server skips the assignment clear and the piggybacked
+        hand-off for the others) and resets the reporter. ``epoch`` is the
+        LANE trial's run epoch (stamped per lane in the block's TRIAL
+        info) — the leader's epoch would let a stale lane FINAL through
+        the driver's epoch guard."""
+        with reporter.lock:
+            data = reporter.get_data() if last else {"logs": []}
+            payload = {"type": "FINAL", "trial_id": trial_id,
+                       "value": None if error else metric,
+                       "logs": data.get("logs") or [],
+                       "epoch": epoch,
+                       "lane": int(lane), "block": block,
+                       "last": bool(last)}
+            if error:
+                payload["error"] = True
+            resp = self._request(payload)
+            if last:
+                reporter.reset()
+        if last:
+            self._handle_final_reply(resp)
         return resp
 
     def preempt_ack(self, trial_id: str, reporter,

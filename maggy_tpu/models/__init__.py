@@ -7,12 +7,12 @@
 - `surgery`: ablatable-module helpers for LOCO model surgery
 """
 
-from maggy_tpu.models.mnist_cnn import MnistCNN
+from maggy_tpu.models.mnist_cnn import MnistCNN, MnistMLP
 from maggy_tpu.models.resnet import ResNet
 from maggy_tpu.models.bert import BertEncoder, BertConfig
 from maggy_tpu.models.llama import Llama, LlamaConfig
 from maggy_tpu.models.moe import MoEMLP
 from maggy_tpu.models.vit import ViT, ViTConfig
 
-__all__ = ["MnistCNN", "ResNet", "BertEncoder", "BertConfig", "Llama",
-           "LlamaConfig", "MoEMLP", "ViT", "ViTConfig"]
+__all__ = ["MnistCNN", "MnistMLP", "ResNet", "BertEncoder", "BertConfig",
+           "Llama", "LlamaConfig", "MoEMLP", "ViT", "ViTConfig"]

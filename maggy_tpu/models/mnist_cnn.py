@@ -38,3 +38,23 @@ class MnistCNN(nn.Module):
         if self.dropout > 0:
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
         return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+class MnistMLP(nn.Module):
+    """Dense-only MNIST classifier for vectorized (K-lane vmapped)
+    sweeps. Matmul/elementwise ops produce bitwise-identical per-lane
+    results under ``jax.vmap`` on every backend we gate on, which the
+    batched-kernel convolutions of ``MnistCNN`` do not — the vectorized
+    bench and the lane-parity tests pin that property on this model."""
+
+    features: int = 8
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.features, dtype=self.dtype)(x)
+        x = nn.tanh(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)

@@ -54,6 +54,11 @@ class Asha(AbstractOptimizer):
         # report(), consumed by suggest() — the split keeps the done
         # decision on the FINAL path while sampling may run ahead.
         self._exhausted = False
+        # K-at-a-time rung drain (config.vmap_lanes > 1, advertised by
+        # the driver as ``self.vmap_lanes``): once a drain starts, the
+        # promotable backlog empties before rung-0 sampling resumes —
+        # True between the first and last promotion of a burst.
+        self._draining = False
 
     def initialize(self) -> None:
         # rf^max_rung rung-0 samples are the minimum that lets one trial
@@ -87,11 +92,13 @@ class Asha(AbstractOptimizer):
         elif self._promotable() is not None:
             self.schedule_version += 1
 
-    def _promotable(self):
-        """Top-down scan for a promotable (not-yet-promoted) trial:
-        (rung, parent_id), or None (reference `asha.py:94-147`). Pure —
-        promotion is committed by suggest()."""
+    def _promotable_all(self) -> List[tuple]:
+        """Every promotable (rung, parent_id), top rung first and
+        best-metric first within a rung — the order both the single-step
+        scan and the K-at-a-time drain consume. Pure — promotion is
+        committed by suggest()."""
         metrics = self.get_metrics_dict()  # normalized: lower is better
+        out: List[tuple] = []
         for rung in sorted(self.rungs.keys(), reverse=True):
             if rung >= self.max_rung:
                 continue
@@ -100,39 +107,65 @@ class Asha(AbstractOptimizer):
             if k == 0:
                 continue
             top_k = sorted(finalized, key=lambda tid: metrics[tid])[:k]
-            candidates = [tid for tid in top_k
-                          if tid not in self.promoted.get(rung, [])]
-            if candidates:
-                return rung, candidates[0]
-        return None
+            out.extend((rung, tid) for tid in top_k
+                       if tid not in self.promoted.get(rung, []))
+        return out
+
+    def _promotable(self):
+        """Top-down scan for a promotable (not-yet-promoted) trial:
+        (rung, parent_id), or None (reference `asha.py:94-147`)."""
+        candidates = self._promotable_all()
+        return candidates[0] if candidates else None
+
+    def _rung0_budget_left(self) -> bool:
+        sampled = sum(1 for t in self.final_store
+                      if t.info_dict.get("rung", 0) == 0)
+        in_flight = sum(1 for t in self.trial_store.values()
+                        if t.info_dict.get("rung", 0) == 0)
+        return sampled + in_flight < self.num_trials
 
     def suggest(self):
         if self._exhausted:
             return None  # a survivor reached the top — experiment done
 
-        promotable = self._promotable()
-        if promotable is not None:
-            rung, parent_id = promotable
-            self.promoted.setdefault(rung, []).append(parent_id)
-            parent_params = self._lookup_params(parent_id)
-            params = self._strip_budget(parent_params)
-            params["budget"] = self.rung_budget(rung + 1)
-            return Trial(
-                params,
-                info_dict={
-                    "sample_type": "promoted",
-                    "rung": rung + 1,
-                    "parent": parent_id,
-                },
-            )
+        promotable = self._promotable_all()
+        if promotable:
+            # K-at-a-time rung drain (vectorized dispatch): under
+            # config.vmap_lanes = K > 1 a lone promotion (scalar — it
+            # restores a checkpoint, so it can never ride a block) would
+            # interleave with the rung-0 sample stream and break block
+            # assembly one trial at a time. Hold promotions while rung-0
+            # sampling can still fill chips, until K pile up — then
+            # drain the whole backlog consecutively, so same-rung
+            # (same-budget, same program family) promotions run
+            # back-to-back on a warm slot and the sample stream stays
+            # contiguous. Scalar mode (lanes == 1) takes promotions
+            # immediately, bit-for-bit the old schedule.
+            lanes = max(1, int(getattr(self, "vmap_lanes", 1) or 1))
+            defer = (lanes > 1 and not self._draining
+                     and len(promotable) < lanes
+                     and self._rung0_budget_left())
+            if not defer:
+                self._draining = len(promotable) > 1
+                rung, parent_id = promotable[0]
+                self.promoted.setdefault(rung, []).append(parent_id)
+                parent_params = self._lookup_params(parent_id)
+                params = self._strip_budget(parent_params)
+                params["budget"] = self.rung_budget(rung + 1)
+                return Trial(
+                    params,
+                    info_dict={
+                        "sample_type": "promoted",
+                        "rung": rung + 1,
+                        "parent": parent_id,
+                    },
+                )
+        else:
+            self._draining = False
 
-        # No promotion possible: fresh random config at rung 0, unless the
-        # sampling budget is exhausted.
-        sampled = sum(1 for t in self.final_store if t.info_dict.get("rung", 0) == 0)
-        in_flight_rung0 = sum(
-            1 for t in self.trial_store.values() if t.info_dict.get("rung", 0) == 0
-        )
-        if sampled + in_flight_rung0 >= self.num_trials:
+        # No promotion possible (or deferred for the drain): fresh random
+        # config at rung 0, unless the sampling budget is exhausted.
+        if not self._rung0_budget_left():
             # Everything sampled; wait for in-flight trials to enable promotion.
             return "IDLE" if self.trial_store else None
         params = self.searchspace.get_random_parameter_values(1, rng=self.rng)[0]

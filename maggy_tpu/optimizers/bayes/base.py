@@ -169,6 +169,48 @@ class BaseAsyncBO(AbstractOptimizer):
                 trial.info_dict["near_duplicate"] = True
         return trial
 
+    #: Weight of the warm-started-neighbor acquisition discount: how
+    #: strongly a candidate near an executed config is favored because it
+    #: is CHEAPER to evaluate, not better — it forks the neighbor's
+    #: checkpoint (config.fork), and under vectorized dispatch
+    #: (config.vmap_lanes > 1) it rides the parent's family as a fork
+    #: lane inside an already-compiled block, costing a lane instead of
+    #: a chip. Scalar forks get half the weight (the checkpoint still
+    #: skips the prefix, but the trial holds its own chip).
+    FORK_DISCOUNT = 0.25
+
+    def warm_neighbor_proximity(self, X_cand) -> Optional[np.ndarray]:
+        """Per-candidate proximity in [0, 1] to the nearest FINALIZED
+        config, linear within ``fork_eps`` of the normalized transform
+        (1 = exact re-run, 0 = at/beyond the fork radius). None when the
+        discount is inactive (``fork_eps`` unset or nothing finalized
+        yet). Subclasses fold this into their acquisition as a
+        cost-awareness tilt — see ``GP.sampling_routine`` /
+        ``TPE.sampling_routine``."""
+        if self.fork_eps is None or not np.isfinite(float(self.fork_eps)) \
+                or float(self.fork_eps) <= 0:
+            return None
+        finalized = self._finalized()
+        if not finalized:
+            return None
+        X = np.asarray(self.searchspace.transform_batch(
+            [self._strip_budget(t.params) for t in finalized]),
+            dtype=np.float64)
+        Xc = np.asarray(X_cand, dtype=np.float64)
+        if Xc.ndim == 1:
+            Xc = Xc[np.newaxis, :]
+        d = np.sqrt(((Xc[:, None, :] - X[None, :, :]) ** 2)
+                    .sum(axis=2)).min(axis=1)
+        return np.clip(1.0 - d / float(self.fork_eps), 0.0, 1.0)
+
+    def fork_discount_weight(self) -> float:
+        """The effective discount weight: full under vectorized lanes
+        (the driver advertises ``vmap_lanes`` on the controller — a fork
+        lane shares its parent's block), half for scalar checkpoint
+        forks."""
+        lanes = max(1, int(getattr(self, "vmap_lanes", 1) or 1))
+        return self.FORK_DISCOUNT * (1.0 if lanes > 1 else 0.5)
+
     def _near_duplicate(self, trial: Trial) -> Optional[str]:
         """The nearest finalized trial within ``fork_eps`` (L2 over the
         searchspace's normalized transform), or None."""

@@ -107,6 +107,21 @@ class GP(BaseAsyncBO):
             X_acq = X_cand
         values = self.acquisition.evaluate(X_acq, model, y_opt)
 
+        # Warm-started-neighbor discount (fork_eps): candidates near an
+        # executed config are cheaper — a checkpoint fork, or under
+        # config.vmap_lanes a fork LANE in the parent's block — so tilt
+        # the (lower-is-better) acquisition toward them, scaled by the
+        # sweep's value spread so the tilt is a preference, never a
+        # takeover of the raw acquisition ranking.
+        prox = self.warm_neighbor_proximity(X_cand)
+        tilt_scale = 0.0
+        if prox is not None:
+            v = np.asarray(values, dtype=np.float64).reshape(-1)
+            spread = float(np.max(v) - np.min(v))
+            if spread > 0.0:
+                tilt_scale = self.fork_discount_weight() * spread
+                values = (v - tilt_scale * prox).reshape(np.shape(values))
+
         if isinstance(self.acquisition, AsyTS):
             best = int(np.argmin(values))
             x_best = X_cand[best]
@@ -117,7 +132,13 @@ class GP(BaseAsyncBO):
 
             def objective(x):
                 xq = np.concatenate([x, [1.0]]) if self.interim_results else x
-                return float(self.acquisition.evaluate(xq[np.newaxis, :], model, y_opt)[0])
+                val = float(self.acquisition.evaluate(
+                    xq[np.newaxis, :], model, y_opt)[0])
+                if tilt_scale > 0.0:
+                    p = self.warm_neighbor_proximity(x[np.newaxis, :])
+                    if p is not None:
+                        val -= tilt_scale * float(p[0])
+                return val
 
             for i in order:
                 x0 = X_cand[i]

@@ -101,12 +101,25 @@ class TPE(BaseAsyncBO):
         kdes = self.models[budget]
         good, bad = kdes["good"], kdes["bad"]
         best_x, best_ei = None, -np.inf
+        weight = self.fork_discount_weight()
         for _ in range(self.num_samples):
             idx = int(self.rng.integers(0, good.n))
             x = good.sample_around(self.rng, idx, bw_factor=self.bw_factor)
             ei = max(good.pdf(x[np.newaxis, :])[0], 1e-32) / max(
                 bad.pdf(x[np.newaxis, :])[0], 1e-32
             )
+            # Warm-started-neighbor discount (fork_eps): the l/g ratio is
+            # higher-is-better, so a candidate near an executed config —
+            # a checkpoint fork, or a fork lane in the parent's vmap
+            # block — gets a multiplicative boost (cost-aware EI). The
+            # KDE's encoding is its own (category indices), so proximity
+            # is measured in the searchspace's normalized transform.
+            prox = None
+            if weight > 0 and self.fork_eps is not None:
+                prox = self.warm_neighbor_proximity(
+                    self.searchspace.transform(self._decode(x)))
+            if prox is not None and prox[0] > 0:
+                ei *= 1.0 + weight * float(prox[0])
             if ei > best_ei:
                 best_x, best_ei = x, ei
         return self._decode(best_x)

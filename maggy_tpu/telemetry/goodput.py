@@ -118,6 +118,7 @@ def compute_goodput(events: List[Dict[str, Any]],
     compiled: Dict[str, Dict[str, Any]] = {}
     ckpts: Dict[str, Dict[str, Any]] = {}
     parent_of: Dict[str, str] = {}
+    block_of: Dict[str, str] = {}
     forked: set = set()
     gangs: List[Dict[str, Any]] = []
     open_gangs: Dict[str, Dict[str, Any]] = {}
@@ -149,6 +150,10 @@ def compute_goodput(events: List[Dict[str, Any]],
                 parent_of[trial] = parent
         elif phase == "assigned":
             assigned.setdefault(trial, []).append((t, pid))
+            if ev.get("block") is not None:
+                # Vectorized block lane (config.vmap_lanes): this trial's
+                # FIRST attempt shares one chip with its block siblings.
+                block_of[trial] = ev["block"]
         elif phase in ("running", "finalized", "preempted", "requeued",
                        "lost"):
             life.setdefault(trial, []).append(
@@ -239,6 +244,21 @@ def compute_goodput(events: List[Dict[str, Any]],
     scratch = set(parent_of) - forked
     subs_done: set = set()
     attempts.sort(key=lambda a: a["t0"])
+    # Vectorized blocks (config.vmap_lanes > 1): a block's K lanes share
+    # ONE chip for the block's window, so each lane attempt carries 1/K
+    # of the wall-seconds it spans. Blocks only assemble at fresh
+    # dispatch and a requeued lane re-runs scalar, so a lane's FIRST
+    # attempt is its block stay — attempts at index 0 of a block-stamped
+    # trial split K ways, and once a lane finalizes early (masked) its
+    # 1/K share of the remaining block window accrues to ``lane_idle``.
+    # Sum over lanes of (live + idle)/K == the block's wall window, so
+    # the per-partition closure identity stays exact.
+    block_attempts: Dict[str, List[Dict[str, Any]]] = {}
+    for a in attempts:
+        blk = block_of.get(a["trial"])
+        if blk is not None and a["index"] == 0:
+            a["vmap_block"] = blk
+            block_attempts.setdefault(blk, []).append(a)
     for a in attempts:
         trial, pid = a["trial"], a["pid"]
         t0, t1 = a["t0"], min(a["t1"], t_end)
@@ -284,11 +304,31 @@ def compute_goodput(events: List[Dict[str, Any]],
             trial_train[trial] = trial_train.get(trial, 0.0) + train
             bk = subs
             bk["train"] = bk.get("train", 0.0) + train
+        blk = a.get("vmap_block")
+        if blk is not None and len(block_attempts[blk]) > 1:
+            k = len(block_attempts[blk])
+            bk = {key: v / k for key, v in bk.items()}
         a["buckets"] = bk
         _add(per_partition.setdefault(pid, _zero()), bk)
         _add(per_trial.setdefault(trial, {}), bk)
         coverage.setdefault(pid, []).append((t0, t1))
         samples_src.setdefault(pid, []).append((t1, bk))
+    # Masked-lane idle: after a lane's own FINAL the block keeps running
+    # on the survivors — the retired lane's 1/K share of that tail is
+    # badput the masked lane "holds" (``lane_idle``), closing each lane's
+    # share at exactly (block_end - block_start) / K.
+    for blk, group in block_attempts.items():
+        k = len(group)
+        if k < 2:
+            continue
+        t_last = min(max(x["t1"] for x in group), t_end)
+        for a in group:
+            idle = max(0.0, t_last - min(a["t1"], t_end)) / k
+            if idle > 0:
+                share = {"lane_idle": idle}
+                _add(a["buckets"], share)
+                _add(per_partition.setdefault(a["pid"], _zero()), share)
+                _add(per_trial.setdefault(a["trial"], {}), share)
     for pid, ta, t1 in pseudo:
         t1 = min(t1, t_end)
         dur = max(0.0, t1 - ta)

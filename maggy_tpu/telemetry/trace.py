@@ -29,6 +29,13 @@ Mapping:
   partition's ``gang`` lane, so an N-chip gang is a grouped band across N
   contiguous partition tracks; placer decisions (``pack`` events —
   reserve/stall/release) are instant markers on the driver track.
+- **vmap lanes**: a vectorized block's K lane trials (``config.
+  vmap_lanes``; lane-stamped ``assigned``/``running``/``finalized``
+  edges) each render on their own ``lane <i>`` sub-track under the
+  shared partition, so the block is a stack of K parallel trial slices
+  and a masked lane's early FINAL is a visibly shorter slice — the
+  ``lane_idle`` tail the goodput ledger charges is the empty space to
+  the block's right edge.
 
 The exporter is pure (events in, dict out) and the journal is the only
 input — any soak/bench artifact can be rendered after the fact.
@@ -65,6 +72,12 @@ _INSTANT_PHASES = ("suggested", "queued", "stop_flagged", "stop_sent",
 #: assembled block is visible as a grouped band across the contiguous
 #: partition tracks (the trial's own slice stays on the leader's tid 0).
 GANG_TID = 1
+
+#: tid base of the per-partition vmap lane sub-tracks: a vectorized
+#: block's lane ``i`` trial renders on tid ``LANE_TID_BASE + i`` under
+#: its partition's process, so the K lanes stack as parallel sub-tracks
+#: (scalar trials stay on tid 0; gang lane is tid 1).
+LANE_TID_BASE = 100
 
 #: ttfm-breakdown fields of a ``compiled`` event, rendered (in runtime
 #: order) as sequential sub-slices inside the attempt's ``startup`` window
@@ -136,16 +149,19 @@ def build_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                         "args": {k: v for k, v in ev.items()
                                  if k not in ("ev", "t")}})
 
+    lane_parts: Dict[int, set] = {}
     for trial_id, evs in by_trial.items():
         evs.sort(key=lambda e: e["t"])
-        out.extend(_trial_slices(trial_id, evs, us))
+        out.extend(_trial_slices(trial_id, evs, us, lane_parts))
         for ev in evs:
             if ev.get("phase") in _INSTANT_PHASES:
                 out.append({"name": "{}:{}".format(ev["phase"],
                                                    trial_id[:8]),
                             "cat": "trial", "ph": "i", "s": "t",
                             "ts": us(ev["t"]),
-                            "pid": _pid(ev.get("partition")), "tid": 0,
+                            "pid": _pid(ev.get("partition")),
+                            "tid": LANE_TID_BASE + int(ev["lane"])
+                            if ev.get("lane") is not None else 0,
                             "args": {k: v for k, v in ev.items()
                                      if k not in ("ev", "t")}})
 
@@ -234,6 +250,13 @@ def build_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             meta.append({"name": "thread_sort_index", "ph": "M",
                          "pid": _pid(p), "tid": GANG_TID,
                          "args": {"sort_index": GANG_TID}})
+        for lane in sorted(lane_parts.get(p, ())):
+            meta.append({"name": "thread_name", "ph": "M", "pid": _pid(p),
+                         "tid": LANE_TID_BASE + lane,
+                         "args": {"name": "lane {}".format(lane)}})
+            meta.append({"name": "thread_sort_index", "ph": "M",
+                         "pid": _pid(p), "tid": LANE_TID_BASE + lane,
+                         "args": {"sort_index": LANE_TID_BASE + lane}})
     out.sort(key=lambda e: e.get("ts", 0))
     return {"traceEvents": meta + out, "displayTimeUnit": "ms",
             "otherData": {"source": "maggy_tpu.telemetry",
@@ -265,10 +288,15 @@ def _gang_band(trial_id: str, assembled: Dict[str, Any], end_us: int,
     return out
 
 
-def _trial_slices(trial_id: str, evs: List[Dict[str, Any]], us) -> List[dict]:
+def _trial_slices(trial_id: str, evs: List[Dict[str, Any]], us,
+                  lane_parts: Optional[Dict[int, set]] = None) -> List[dict]:
     """Slices for one trial: one outer slice (+ phase sub-slices) per run
     attempt, split on ``assigned`` occurrences so a requeued trial renders
-    as separate slices on each partition it visited."""
+    as separate slices on each partition it visited. A vectorized block
+    lane attempt (lane-stamped edges) lands on its partition's ``lane <i>``
+    sub-track (tid ``LANE_TID_BASE + i``) so the block's K trials stack;
+    ``lane_parts`` (partition -> lane indices) collects the sub-tracks the
+    caller must name."""
     out: List[dict] = []
     attempts: List[List[Dict[str, Any]]] = []
     for ev in evs:
@@ -279,12 +307,15 @@ def _trial_slices(trial_id: str, evs: List[Dict[str, Any]], us) -> List[dict]:
         marks: Dict[str, float] = {}
         partition = None
         terminal = None
+        lane = None
         for ev in attempt:
             phase = ev.get("phase")
             if phase not in marks:
                 marks[phase] = ev["t"]
             if ev.get("partition") is not None:
                 partition = int(ev["partition"])
+            if ev.get("lane") is not None:
+                lane = int(ev["lane"])
             if phase in ("finalized", "lost") and terminal is None:
                 terminal = ev["t"]
         start = marks.get("assigned")
@@ -293,23 +324,29 @@ def _trial_slices(trial_id: str, evs: List[Dict[str, Any]], us) -> List[dict]:
         end = terminal if terminal is not None else attempt[-1]["t"]
         if end < start:
             continue
+        tid = 0
+        if lane is not None:
+            tid = LANE_TID_BASE + lane
+            if lane_parts is not None:
+                lane_parts.setdefault(partition, set()).add(lane)
         args = {"trial": trial_id}
         final = next((e for e in attempt if e.get("phase") == "finalized"),
                      None)
         if final is not None:
-            args.update({k: final[k] for k in ("early_stop", "error", "span")
+            args.update({k: final[k] for k in ("early_stop", "error", "span",
+                                               "lane", "block")
                          if final.get(k) is not None})
         out.append({"name": "trial {}".format(trial_id[:8]), "cat": "trial",
                     "ph": "X", "ts": us(start),
                     "dur": max(1, us(end) - us(start)),
-                    "pid": _pid(partition), "tid": 0, "args": args})
+                    "pid": _pid(partition), "tid": tid, "args": args})
         for name, p_from, p_to in _SUB_SLICES:
             a, b = marks.get(p_from), marks.get(p_to)
             if a is None or b is None or b < a:
                 continue
             out.append({"name": name, "cat": "phase", "ph": "X",
                         "ts": us(a), "dur": max(1, us(b) - us(a)),
-                        "pid": _pid(partition), "tid": 0,
+                        "pid": _pid(partition), "tid": tid,
                         "args": {"trial": trial_id}})
         # Runner-attributed ttfm breakdown: the compiled event carries
         # DURATIONS (runner clock), so the sub-slices are laid out
@@ -328,7 +365,7 @@ def _trial_slices(trial_id: str, evs: List[Dict[str, Any]], us) -> List[dict]:
                 dur = max(1, int(round(ms * 1e3)))
                 out.append({"name": "{} ({})".format(name, warm_tag),
                             "cat": "compile", "ph": "X", "ts": cursor,
-                            "dur": dur, "pid": _pid(partition), "tid": 0,
+                            "dur": dur, "pid": _pid(partition), "tid": tid,
                             "args": {"trial": trial_id, key: ms,
                                      "warm": bool(compiled.get("warm"))}})
                 cursor += dur

@@ -128,6 +128,11 @@ REQUEUE_REASONS = frozenset({
                          # from-scratch run — journaled so genealogy
                          # shows the downgrade instead of a silent
                          # restart-at-0
+    "vmap_block_lost",   # a vectorized block's runner died (LOST/BLACK)
+                         # or its leader was preempted: every live lane
+                         # requeues exactly once as an individual scalar
+                         # trial (chaos invariant 16 — no phantom FINALs,
+                         # no lane lost to the block seam)
 })
 
 #: ``reason=`` on a ``profile_captured`` event: what triggered the
@@ -218,6 +223,10 @@ GOODPUT_BUCKETS = (
     "handoff",        # FINAL -> next running gap (< HANDOFF_CAP_S)
     "queue_wait",     # runner registered -> first trial running
     "idle",           # reserved but trial-less (rung barriers, drain)
+    "lane_idle",      # vectorized blocks (config.vmap_lanes): a masked
+                      #   (early-stopped) lane's share of block chip-time
+                      #   after its own FINAL while surviving lanes kept
+                      #   training — the price of lockstep execution
     "unaccounted",    # residual the accounting could not attribute
 )
 

@@ -1,4 +1,5 @@
 from maggy_tpu.train.trainer import (
+    build_step_fn,
     cross_entropy_loss,
     init_train_state,
     make_train_step,
@@ -8,9 +9,10 @@ from maggy_tpu.train.trainer import (
 )
 from maggy_tpu.train.data import ShardedBatchIterator
 from maggy_tpu.train.registry import DatasetRegistry
+from maggy_tpu.train.vmap import VmapTrainer
 from maggy_tpu.train.warm import clear_warm, warm_cache
 
-__all__ = ["cross_entropy_loss", "init_train_state", "make_train_step",
-           "next_token_loss", "swept_transform", "Trainer",
-           "ShardedBatchIterator", "DatasetRegistry", "clear_warm",
-           "warm_cache"]
+__all__ = ["build_step_fn", "cross_entropy_loss", "init_train_state",
+           "make_train_step", "next_token_loss", "swept_transform",
+           "Trainer", "VmapTrainer", "ShardedBatchIterator",
+           "DatasetRegistry", "clear_warm", "warm_cache"]

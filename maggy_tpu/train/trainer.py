@@ -263,26 +263,20 @@ def _init_state_via_slot(slot, model, tx, rng, example_inputs, mesh,
     return params, opt_state, entry.shardings, hit, ikey
 
 
-def make_train_step(
+def build_step_fn(
     model,
     tx,
     loss_fn: Callable,
     mesh,
-    donate: bool = True,
     has_aux_collections: bool = False,
     train_kwargs: Optional[Dict[str, Any]] = None,
     strategy: str = "dp",
 ):
-    """Build the jitted SPMD train step.
+    """The raw (unjitted) train-step closure ``make_train_step`` jits.
 
-    step(variables, opt_state, batch) -> (variables, opt_state, loss).
-    ``loss_fn(logits_or_outputs, batch)`` computes the scalar loss; gradient
-    all-reduce/reduce-scatter over the mesh comes from GSPMD. With a
-    "zero" strategy part, the updated optimizer state is constrained to
-    its data-axis sharding so XLA keeps the moments de-duplicated across
-    replicas (shapes are static at trace time, so the constraint costs
-    nothing when already satisfied).
-    """
+    Exposed separately so the vectorized K-lane path (train/vmap.py) can
+    wrap the IDENTICAL computation in ``jax.vmap`` over the stacked state
+    axis — one program family, scalar and vectorized."""
     from maggy_tpu.parallel.sharding import apply_zero_sharding
 
     train_kwargs = train_kwargs or {}
@@ -315,6 +309,32 @@ def make_train_step(
         return {"params": params, **new_aux} if has_aux_collections else \
             {"params": params, **aux}, opt_state, loss
 
+    return step
+
+
+def make_train_step(
+    model,
+    tx,
+    loss_fn: Callable,
+    mesh,
+    donate: bool = True,
+    has_aux_collections: bool = False,
+    train_kwargs: Optional[Dict[str, Any]] = None,
+    strategy: str = "dp",
+):
+    """Build the jitted SPMD train step.
+
+    step(variables, opt_state, batch) -> (variables, opt_state, loss).
+    ``loss_fn(logits_or_outputs, batch)`` computes the scalar loss; gradient
+    all-reduce/reduce-scatter over the mesh comes from GSPMD. With a
+    "zero" strategy part, the updated optimizer state is constrained to
+    its data-axis sharding so XLA keeps the moments de-duplicated across
+    replicas (shapes are static at trace time, so the constraint costs
+    nothing when already satisfied).
+    """
+    step = build_step_fn(model, tx, loss_fn, mesh,
+                         has_aux_collections=has_aux_collections,
+                         train_kwargs=train_kwargs, strategy=strategy)
     jit_kwargs = {}
     if donate:
         jit_kwargs["donate_argnums"] = (0, 1)

@@ -348,15 +348,55 @@ class _InitEntry:
             self.retired = None
 
 
+class _VmapEntry:
+    """Per-(program, K-lanes, input-shape) vectorized warm state: the ONE
+    AOT-compiled K-lane vmapped step executable every block of the family
+    shares, and the STACKED retired state buffers of the previous block —
+    consumed by the next block's donating re-init exactly like the scalar
+    ``_InitEntry.retired`` cell, generalized across the lane axis."""
+
+    __slots__ = ("vstep", "lanes", "retired", "lock")
+
+    def __init__(self, lanes: int):
+        self.lanes = lanes
+        self.vstep = None  # guarded-by: lock  # compiled K-lane executable
+        self.retired: Optional[tuple] = None  # guarded-by: lock
+        self.lock = threading.Lock()
+
+    def ensure_vstep(self, build: Callable[[], Any]):
+        with self.lock:
+            if self.vstep is None:
+                self.vstep = build()
+            return self.vstep
+
+    def store_retired(self, stacked_vars, stacked_opt, family) -> None:
+        with self.lock:
+            self.retired = (stacked_vars, stacked_opt, family)
+
+    def take_retired(self) -> Optional[tuple]:
+        """Pop the stacked retired buffers (single consumer: they are
+        DONATED to the block re-init, a second taker would read deleted
+        arrays)."""
+        with self.lock:
+            retired, self.retired = self.retired, None
+            return retired
+
+    def drop_retired(self) -> None:
+        with self.lock:
+            self.retired = None
+
+
 class WarmSlot:
     """One program family's warm state. ``step_jit`` is shared by every
     trial of the family (jax.jit re-traces per input shape internally);
     ``compiled`` holds the AOT-split executables per shape so repeat
     trials skip trace AND compile; ``inits`` holds per-input-shape init
-    entries."""
+    entries; ``vmaps`` holds per-(lanes, shape) vectorized entries (the
+    K-lane executables + stacked retired buffers of vectorized blocks,
+    train/vmap.py)."""
 
     __slots__ = ("key", "lock", "step_jit", "compiled", "inits", "aot_ok",
-                 "aot_lock")
+                 "aot_lock", "vmaps")
 
     def __init__(self, key):
         self.key = key
@@ -364,12 +404,27 @@ class WarmSlot:
         self.step_jit = None  # guarded-by: lock
         self.compiled: "OrderedDict[str, Any]" = OrderedDict()  # guarded-by: lock
         self.inits: "OrderedDict[Any, _InitEntry]" = OrderedDict()  # guarded-by: lock
+        self.vmaps: "OrderedDict[Any, _VmapEntry]" = OrderedDict()  # guarded-by: lock
         self.aot_ok = True
         # Serializes AOT lower+compile per slot: N thread-pooled runners
         # whose first trials race the same program must produce ONE
         # compile, not N concurrent ones (the plain-jit path gets the
         # same guarantee from pjit's internal cache locking).
         self.aot_lock = threading.Lock()
+
+    def vmap_entry(self, key, lanes: int) -> "_VmapEntry":
+        """Get-or-create the vectorized entry for one (lanes, shape)
+        signature; bounded by the same per-slot LRU as ``compiled``."""
+        with self.lock:
+            entry = self.vmaps.get(key)
+            if entry is None or entry.lanes != lanes:
+                entry = _VmapEntry(lanes)
+                self.vmaps[key] = entry
+                while len(self.vmaps) > PER_SLOT_SHAPES:
+                    self.vmaps.popitem(last=False)
+            else:
+                self.vmaps.move_to_end(key)
+            return entry
 
     def ensure_step(self, build: Callable[[], Any]):
         with self.lock:

@@ -113,6 +113,15 @@ def pytest_configure(config):
         "A/B gate is `bench.py --goodput`; the fault-free control soak "
         "is `python -m maggy_tpu.chaos --goodput`. Select with "
         "-m goodput.")
+    config.addinivalue_line(
+        "markers",
+        "vmap: vectorized micro-trial tests (train/vmap.py, "
+        "config.vmap_lanes) — K-lane VmapTrainer bitwise parity vs "
+        "scalar runs, lane masking/refill, driver block assembly with "
+        "scalar fallback for incompatible configs, lane-tagged journal "
+        "edges, and the lane_idle goodput split. The kill-mid-block "
+        "soak is `python -m maggy_tpu.chaos --vmap`; the A/B gate is "
+        "`bench.py --vmap`. Select with -m vmap.")
 
 
 @pytest.fixture(autouse=True)

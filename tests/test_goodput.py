@@ -470,7 +470,7 @@ class TestSurfaces:
         assert GOODPUT_BUCKETS == (
             "train", "init", "trace", "compile", "ckpt_save",
             "ckpt_restore", "fork_stage", "rework", "handoff",
-            "queue_wait", "idle", "unaccounted")
+            "queue_wait", "idle", "lane_idle", "unaccounted")
 
     def test_telem_snapshot_carries_goodput_and_gauges(self):
         from maggy_tpu.telemetry import Telemetry
